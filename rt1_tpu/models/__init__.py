@@ -1,0 +1,1 @@
+"""Model components: RT-1 network, transformer, tokenizers, FiLM-EfficientNet."""

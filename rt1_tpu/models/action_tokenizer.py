@@ -1,0 +1,94 @@
+"""Discretized action tokenizer.
+
+Functional JAX re-design of `pytorch_robotics_transformer/tokenizers/action_tokenizer.py`
+(`RT1ActionTokenizer`, tokenize `:105-128`, detokenize `:131-159`). Semantics match the
+reference exactly:
+
+* a `DiscreteSpec` action contributes 1 token, passed through as its own token id;
+* a rank-1 `BoxSpec` action contributes `shape[0]` tokens: values are clipped to
+  [low, high], min-max normalized, scaled by `vocab_size - 1`, then **truncated**
+  (not rounded) to int32 — the reference uses `.to(torch.int32)`
+  (`action_tokenizer.py:124`) which truncates;
+* detokenize inverts: `token / (vocab_size - 1) * (high - low) + low`
+  (`action_tokenizer.py:154-155`);
+* out-of-vocabulary Discrete tokens map to 0 — the reference's quirky comparison is
+  `token > n` (strictly greater, `action_tokenizer.py:145`), reproduced verbatim so a
+  poor model emitting exactly `n` behaves identically.
+
+Everything is pure jnp on arrays with arbitrary leading batch dims, so the same
+functions serve the (b, t) training path and the (1,) inference path, vmap/jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import jax.numpy as jnp
+
+from rt1_tpu.specs import BoxSpec, DiscreteSpec, Spec
+
+
+def tokens_per_action(action_space: Mapping[str, Spec]) -> int:
+    """Number of tokens one action maps to (action_tokenizer.py:83-98)."""
+    n = 0
+    for key, spec in action_space.items():
+        if isinstance(spec, DiscreteSpec):
+            n += 1
+        elif isinstance(spec, BoxSpec):
+            if len(spec.shape) != 1:
+                raise ValueError(
+                    f"Only action shapes with single dimension supported, got {spec.shape}"
+                )
+            n += spec.shape[0]
+        else:
+            raise ValueError(f"action space entries must be Discrete or Box, got {spec!r} for {key!r}")
+    return n
+
+
+def tokenize(
+    action_space: Mapping[str, Spec],
+    action: Dict[str, jnp.ndarray],
+    vocab_size: int,
+) -> jnp.ndarray:
+    """Map an action dict to int32 tokens of shape (..., tokens_per_action)."""
+    parts = []
+    for key, spec in action_space.items():
+        a = jnp.asarray(action[key])
+        if isinstance(spec, DiscreteSpec):
+            parts.append(a.astype(jnp.int32)[..., None])
+        elif isinstance(spec, BoxSpec):
+            low = jnp.asarray(spec.low_array())
+            high = jnp.asarray(spec.high_array())
+            a = jnp.clip(a, low, high)
+            t = (a - low) / (high - low)
+            t = t * (vocab_size - 1)
+            parts.append(t.astype(jnp.int32))  # truncation, like torch .to(int32)
+        else:
+            raise ValueError(f"unsupported spec {spec!r}")
+    return jnp.concatenate(parts, axis=-1)
+
+
+def detokenize(
+    action_space: Mapping[str, Spec],
+    action_tokens: jnp.ndarray,
+    vocab_size: int,
+) -> Dict[str, jnp.ndarray]:
+    """Invert `tokenize`; tokens shape (..., tokens_per_action) → action dict."""
+    action: Dict[str, jnp.ndarray] = {}
+    idx = 0
+    for key, spec in action_space.items():
+        if isinstance(spec, DiscreteSpec):
+            tok = action_tokens[..., idx]
+            # Reference quirk: strictly-greater comparison (action_tokenizer.py:145).
+            action[key] = jnp.where(tok > spec.n, jnp.zeros_like(tok), tok)
+            idx += 1
+        elif isinstance(spec, BoxSpec):
+            dim = spec.shape[0]
+            tok = action_tokens[..., idx : idx + dim].astype(jnp.float32)
+            low = jnp.asarray(spec.low_array())
+            high = jnp.asarray(spec.high_array())
+            action[key] = tok / (vocab_size - 1) * (high - low) + low
+            idx += dim
+        else:
+            raise ValueError(f"unsupported spec {spec!r}")
+    return action

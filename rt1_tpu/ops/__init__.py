@@ -1,0 +1,1 @@
+"""Low-level ops: image preprocessing, attention primitives, Pallas kernels."""

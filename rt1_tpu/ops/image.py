@@ -1,0 +1,82 @@
+"""On-device image preprocessing ops.
+
+TPU-native replacement for `pytorch_robotics_transformer/film_efficientnet/
+preprocessors.py:37-56` (`convert_dtype_and_crop_images`): uint8→[0,1] conversion and
+the pad-±ratio / random-shift-crop-back augmentation. The reference builds a meshgrid
+and fancy-indexes on GPU; here the crop is a single `lax.dynamic_slice` on the padded
+image — static output shape, fuses cleanly under jit, and vmaps over the batch.
+
+Layout note: all rt1_tpu image ops are NHWC (TPU-preferred), vs the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def convert_dtype(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [0,255] → float32 [0,1]; float inputs pass through as float32."""
+    if images.dtype == jnp.uint8:
+        images = images.astype(jnp.float32) / 255.0
+    return images.astype(jnp.float32)
+
+
+def random_shift_crop(
+    images: jnp.ndarray,
+    rng: jax.Array,
+    ratio: float = 0.07,
+) -> jnp.ndarray:
+    """Pad H/W by `int(dim * ratio)` each side, crop back at a random offset.
+
+    Matches preprocessors.py:42-54: one shift is drawn per *batch* (the reference
+    draws a single (shif_h, shif_w) for the whole batch), offsets uniform over
+    [0, 2*pad] inclusive. Input/output: (..., H, W, C), any leading batch dims.
+    """
+    h, w = images.shape[-3], images.shape[-2]
+    ud_pad = int(h * ratio)
+    lr_pad = int(w * ratio)
+    pad_cfg = [(0, 0)] * (images.ndim - 3) + [(ud_pad, ud_pad), (lr_pad, lr_pad), (0, 0)]
+    padded = jnp.pad(images, pad_cfg)
+    rng_h, rng_w = jax.random.split(rng)
+    shift_h = jax.random.randint(rng_h, (), 0, 2 * ud_pad + 1)
+    shift_w = jax.random.randint(rng_w, (), 0, 2 * lr_pad + 1)
+    starts = [jnp.zeros((), jnp.int32)] * (images.ndim - 3) + [shift_h, shift_w, jnp.zeros((), jnp.int32)]
+    return lax.dynamic_slice(padded, starts, images.shape)
+
+
+def convert_dtype_and_crop_images(
+    images: jnp.ndarray,
+    rng: jax.Array | None = None,
+    ratio: float = 0.07,
+    train: bool = True,
+) -> jnp.ndarray:
+    """dtype conversion + (train only) random shift crop, as one fused op."""
+    images = convert_dtype(images)
+    if train and rng is not None and ratio > 0:
+        images = random_shift_crop(images, rng, ratio)
+    return images
+
+
+def resize_bilinear(images: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
+    """Bilinear resize to (height, width); NHWC, any leading dims."""
+    shape = images.shape[:-3] + (height, width, images.shape[-1])
+    return jax.image.resize(images, shape, method="bilinear")
+
+
+def central_crop_and_resize(
+    images: jnp.ndarray, crop_factor: float, height: int, width: int
+) -> jnp.ndarray:
+    """Deterministic center crop by `crop_factor` then resize.
+
+    Eval-side equivalent of the train random crop — mirrors
+    `language_table/eval/wrappers.py:99-123` (`CentralCropImageWrapper`).
+    """
+    h, w = images.shape[-3], images.shape[-2]
+    ch = int(h * crop_factor)
+    cw = int(w * crop_factor)
+    top = (h - ch) // 2
+    left = (w - cw) // 2
+    cropped = images[..., top : top + ch, left : left + cw, :]
+    return resize_bilinear(cropped, height, width)

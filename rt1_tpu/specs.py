@@ -1,0 +1,165 @@
+"""Tensor/action space specs.
+
+The reference uses `gym.spaces.Dict` throughout the model core
+(`pytorch_robotics_transformer/transformer_network.py:40-41`,
+`tokenizers/action_tokenizer.py:68-98`). Gym spaces are host-Python objects with
+numpy state — fine at the environment boundary, but inside a jitted TPU program we
+want hashable, static pytree-free metadata. These dataclasses carry the same
+information (bounds, shape, cardinality) as frozen, hashable Python objects that can
+be closed over by `jax.jit` without retracing hazards.
+
+`sample_spec`/`sample_space` replace the reference's `batched_space_sampler`
+(`tokenizers/utils.py:8-18`), which fabricates random network_state/action batches
+for tests and the training path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteSpec:
+    """A categorical value in [0, n). Mirrors `gym.spaces.Discrete(n)`."""
+
+    n: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return ()
+
+    @property
+    def dtype(self):
+        return jnp.int32
+
+
+@dataclasses.dataclass(frozen=True)
+class BoxSpec:
+    """A bounded continuous vector. Mirrors 1-D `gym.spaces.Box`.
+
+    `low`/`high` are tuples (hashable) broadcastable to `shape`. Only rank-1 boxes
+    are tokenizable, matching the reference's restriction
+    (`tokenizers/action_tokenizer.py:92-95`).
+    """
+
+    low: Tuple[float, ...]
+    high: Tuple[float, ...]
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        if len(self.low) not in (1, int(np.prod(self.shape)) if self.shape else 1):
+            raise ValueError(f"low {self.low} not broadcastable to {self.shape}")
+        if len(self.high) not in (1, int(np.prod(self.shape)) if self.shape else 1):
+            raise ValueError(f"high {self.high} not broadcastable to {self.shape}")
+
+    @property
+    def dtype(self):
+        return jnp.float32
+
+    def low_array(self) -> np.ndarray:
+        return np.broadcast_to(np.asarray(self.low, np.float32), self.shape)
+
+    def high_array(self) -> np.ndarray:
+        return np.broadcast_to(np.asarray(self.high, np.float32), self.shape)
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageSpec:
+    """An image observation; values in [0, 1] (or uint8 [0,255] pre-normalization).
+
+    NOTE: TPU-native layout is NHWC (height, width, channels) — the reference is
+    NCHW (`transformer_network.py:424`); layout conversion happens at the data/env
+    boundary, never inside the model.
+    """
+
+    height: int
+    width: int
+    channels: int = 3
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.height, self.width, self.channels)
+
+    @property
+    def dtype(self):
+        return jnp.float32
+
+
+@dataclasses.dataclass(frozen=True)
+class VectorSpec:
+    """An unbounded float vector (e.g. a 512-d language embedding)."""
+
+    size: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.size,)
+
+    @property
+    def dtype(self):
+        return jnp.float32
+
+
+Spec = Union[DiscreteSpec, BoxSpec, ImageSpec, VectorSpec]
+SpecDict = Mapping[str, Spec]
+
+
+def sample_spec(spec: Spec, rng: jax.Array, batch_shape: Tuple[int, ...] = ()):
+    """Sample a random value of `spec` with leading `batch_shape` dims.
+
+    Replaces `batched_space_sampler` + `np_to_tensor`
+    (`tokenizers/utils.py:8-26`) — returns device arrays directly.
+    """
+    if isinstance(spec, DiscreteSpec):
+        return jax.random.randint(rng, batch_shape, 0, spec.n, dtype=jnp.int32)
+    if isinstance(spec, BoxSpec):
+        lo = jnp.asarray(spec.low_array())
+        hi = jnp.asarray(spec.high_array())
+        u = jax.random.uniform(rng, batch_shape + spec.shape, jnp.float32)
+        return lo + u * (hi - lo)
+    if isinstance(spec, (ImageSpec, VectorSpec)):
+        return jax.random.uniform(rng, batch_shape + spec.shape, jnp.float32)
+    raise TypeError(f"unknown spec {spec!r}")
+
+
+def sample_space(space: SpecDict, rng: jax.Array, batch_shape: Tuple[int, ...] = ()) -> Dict[str, jax.Array]:
+    """Sample every entry of a spec dict (ordered, like the reference's OrderedDict)."""
+    rngs = jax.random.split(rng, len(space))
+    return {k: sample_spec(s, r, batch_shape) for (k, s), r in zip(space.items(), rngs)}
+
+
+# ---------------------------------------------------------------------------
+# Canonical Language-Table spaces (reference: distribute_train.py:28-55).
+# ---------------------------------------------------------------------------
+
+def language_table_observation_space(height: int = 256, width: int = 456) -> Dict[str, Spec]:
+    return {
+        "image": ImageSpec(height=height, width=width, channels=3),
+        "natural_language_embedding": VectorSpec(512),
+    }
+
+
+def language_table_action_space() -> Dict[str, Spec]:
+    # Order matters for tokenization (action_tokenizer.py:81). The reference uses
+    # OrderedDict([('terminate_episode', Discrete(2)), ('action', Box(-0.1, 0.1, (2,)))])
+    # (distribute_train.py:40-46) → tokens_per_action == 3.
+    return {
+        "terminate_episode": DiscreteSpec(2),
+        "action": BoxSpec(low=(-0.1,), high=(0.1,), shape=(2,)),
+    }
+
+
+def rt1_generic_action_space() -> Dict[str, Spec]:
+    # The 4-key generic RT-1 action space used by the reference's network tests
+    # (transformer_network_test_set_up.py:79-110) → tokens_per_action == 8.
+    return {
+        "terminate_episode": DiscreteSpec(2),
+        "world_vector": BoxSpec(low=(-1.0,), high=(1.0,), shape=(3,)),
+        "rotation_delta": BoxSpec(low=(-np.pi / 2.0,), high=(np.pi / 2.0,), shape=(3,)),
+        "gripper_closedness_action": BoxSpec(low=(-1.0,), high=(1.0,), shape=(1,)),
+    }

@@ -1,0 +1,119 @@
+"""Action tokenizer tests.
+
+Mirrors the reference's `tokenizers/action_tokenizer_test.py` coverage: token
+accounting, Discrete/Box tokenize, OOV detokenize, limit values mapping to
+0/vocab-1, invalid 2-D Box rejection, and fuzzed tokenize∘detokenize round-trips
+(including batched), plus numeric parity against the torch reference formulas.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rt1_tpu.models import action_tokenizer
+from rt1_tpu.specs import (
+    BoxSpec,
+    DiscreteSpec,
+    language_table_action_space,
+    rt1_generic_action_space,
+    sample_space,
+)
+
+VOCAB = 256
+
+
+def test_tokens_per_action_language_table():
+    # terminate Discrete(2) → 1 token, action Box(2,) → 2 tokens (distribute_train.py:40-46)
+    assert action_tokenizer.tokens_per_action(language_table_action_space()) == 3
+
+
+def test_tokens_per_action_generic_rt1():
+    # transformer_network_test_set_up.py: 1 + 3 + 3 + 1 = 8
+    assert action_tokenizer.tokens_per_action(rt1_generic_action_space()) == 8
+
+
+def test_rank2_box_raises():
+    space = {"bad": BoxSpec(low=(-1.0,), high=(1.0,), shape=(2, 2))}
+    with pytest.raises(ValueError, match="single dimension"):
+        action_tokenizer.tokens_per_action(space)
+
+
+def test_discrete_tokenize_identity():
+    space = {"terminate_episode": DiscreteSpec(2)}
+    toks = action_tokenizer.tokenize(space, {"terminate_episode": jnp.asarray(1)}, VOCAB)
+    assert toks.shape == (1,)
+    assert int(toks[0]) == 1
+
+
+def test_box_limits_map_to_extremes():
+    # action_tokenizer_test.py:111-129: low → token 0, high → token vocab-1.
+    space = language_table_action_space()
+    act = {"terminate_episode": jnp.asarray(0), "action": jnp.asarray([-0.1, 0.1])}
+    toks = action_tokenizer.tokenize(space, act, VOCAB)
+    np.testing.assert_array_equal(np.asarray(toks), [0, 0, VOCAB - 1])
+    # Out-of-bounds values clip first (action_tokenizer.py:119).
+    act = {"terminate_episode": jnp.asarray(0), "action": jnp.asarray([-5.0, 5.0])}
+    toks = action_tokenizer.tokenize(space, act, VOCAB)
+    np.testing.assert_array_equal(np.asarray(toks), [0, 0, VOCAB - 1])
+
+
+def test_tokenize_truncates_like_torch():
+    # torch `.to(torch.int32)` truncates; e.g. normalized 0.9999 * 255 = 254.97 → 254.
+    space = {"a": BoxSpec(low=(0.0,), high=(1.0,), shape=(1,))}
+    toks = action_tokenizer.tokenize(space, {"a": jnp.asarray([0.9999])}, VOCAB)
+    assert int(toks[0]) == 254
+
+
+def test_discrete_detokenize_oov_to_zero():
+    # Reference quirk is strictly-greater (action_tokenizer.py:145): token n passes.
+    space = {"terminate_episode": DiscreteSpec(2)}
+    out = action_tokenizer.detokenize(space, jnp.asarray([3]), VOCAB)
+    assert int(out["terminate_episode"]) == 0
+    out = action_tokenizer.detokenize(space, jnp.asarray([2]), VOCAB)
+    assert int(out["terminate_episode"]) == 2  # reproduces `> n` behavior
+
+
+def test_roundtrip_fuzz(rng):
+    # action_tokenizer_test.py:141-179: detokenize(tokenize(a)) ≈ a (the reference
+    # asserts value closeness, not token equality — truncation makes token-level
+    # round-trips only stable to ±1 under float32).
+    space = rt1_generic_action_space()
+    vocab = 1024  # matches the reference fuzz test's vocab_size
+    for i in range(10):
+        act = sample_space(space, jax.random.fold_in(rng, i))
+        toks = action_tokenizer.tokenize(space, act, vocab)
+        act2 = action_tokenizer.detokenize(space, toks, vocab)
+        for k in act:
+            np.testing.assert_allclose(
+                np.asarray(act[k], np.float32), np.asarray(act2[k], np.float32), atol=1e-2
+            )
+        toks2 = action_tokenizer.tokenize(space, act2, vocab)
+        assert int(np.max(np.abs(np.asarray(toks) - np.asarray(toks2)))) <= 1
+
+
+def test_roundtrip_batched(rng):
+    space = language_table_action_space()
+    act = sample_space(space, rng, batch_shape=(4, 6))
+    toks = action_tokenizer.tokenize(space, act, VOCAB)
+    assert toks.shape == (4, 6, 3)
+    act2 = action_tokenizer.detokenize(space, toks, VOCAB)
+    assert act2["terminate_episode"].shape == (4, 6)
+    assert act2["action"].shape == (4, 6, 2)
+    toks2 = action_tokenizer.tokenize(space, act2, VOCAB)
+    assert int(np.max(np.abs(np.asarray(toks) - np.asarray(toks2)))) <= 1
+    # Detokenized Box values are within a bucket of the (clipped) originals.
+    bucket = 0.2 / (VOCAB - 1)
+    np.testing.assert_allclose(
+        np.asarray(act2["action"]), np.asarray(act["action"]), atol=bucket + 1e-6
+    )
+
+
+def test_jit_and_vmap():
+    space = language_table_action_space()
+    f = jax.jit(lambda a: action_tokenizer.tokenize(space, a, VOCAB))
+    act = {"terminate_episode": jnp.ones((8,), jnp.int32), "action": jnp.zeros((8, 2))}
+    toks = f(act)
+    assert toks.shape == (8, 3)
+    # mid-range value 0.0 → (0.0 - -0.1)/0.2 * 255 = 127.5 → truncates to 127
+    assert int(toks[0, 1]) == 127

@@ -1,0 +1,47 @@
+"""Image preprocessing op tests (reference: film_efficientnet/preprocessors.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rt1_tpu.ops import image as image_ops
+
+
+def test_convert_dtype_uint8():
+    img = jnp.full((2, 4, 4, 3), 255, jnp.uint8)
+    out = image_ops.convert_dtype(img)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_random_shift_crop_shape_and_content(rng):
+    b, h, w = 2, 30, 40
+    img = jnp.arange(b * h * w * 3, dtype=jnp.float32).reshape(b, h, w, 3) / (b * h * w * 3)
+    out = image_ops.random_shift_crop(img, rng, ratio=0.07)
+    assert out.shape == img.shape
+    # Every output pixel is either 0 (pad) or present in the input.
+    out_np = np.asarray(out)
+    in_vals = set(np.asarray(img).ravel().tolist())
+    for v in out_np.ravel()[:100].tolist():
+        assert v == 0.0 or v in in_vals
+
+
+def test_random_shift_crop_zero_shift_identity():
+    # With ratio small enough that pad = 0, crop is the identity.
+    img = jnp.ones((1, 10, 10, 3))
+    out = image_ops.random_shift_crop(img, jax.random.PRNGKey(0), ratio=0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(img))
+
+
+def test_crop_is_jittable(rng):
+    img = jnp.zeros((2, 6, 30, 40, 3))  # (b, t, h, w, c) — works with leading dims
+    f = jax.jit(lambda x, r: image_ops.convert_dtype_and_crop_images(x, r))
+    out = f(img, rng)
+    assert out.shape == img.shape
+
+
+def test_central_crop_and_resize():
+    img = jnp.ones((1, 180, 320, 3))
+    out = image_ops.central_crop_and_resize(img, crop_factor=0.95, height=256, width=456)
+    assert out.shape == (1, 256, 456, 3)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
