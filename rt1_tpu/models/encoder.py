@@ -1,0 +1,52 @@
+"""EfficientNet-based image encoder with late FiLM.
+
+Re-design of `pytorch_robotics_transformer/film_efficientnet/pretrained_efficientnet_encoder.py:36-74`
+(`EfficientNetEncoder`): FiLM-EfficientNet-B3 (no top) → 1×1 conv to the token
+embedding size (no bias, `:45-51`) → one final FiLM layer (`:53,68`) → either the
+spatial feature map (pooling=False, the tokenizer path) or a mean-pooled vector
+(pooling=True, `:74`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rt1_tpu.models.efficientnet import EfficientNetB3
+from rt1_tpu.models.film import FilmConditioning
+
+
+class EfficientNetEncoder(nn.Module):
+    token_embedding_size: int = 512
+    early_film: bool = True
+    pooling: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        image: jnp.ndarray,
+        context: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """image: (B, H, W, 3); context: (B, 512). Returns (B, h, w, E) or (B, E)."""
+        net = EfficientNetB3(include_top=False, include_film=self.early_film, dtype=self.dtype)
+        if self.early_film:
+            features = net(image, context=context, train=train)
+        else:
+            features = net(image, train=train)
+        features = nn.Conv(
+            self.token_embedding_size,
+            (1, 1),
+            use_bias=False,
+            dtype=self.dtype,
+            name="conv1x1",
+        )(features)
+        features = FilmConditioning(self.token_embedding_size, dtype=self.dtype, name="film")(
+            features, context
+        )
+        if not self.pooling:
+            return features
+        return jnp.mean(features, axis=(-3, -2))

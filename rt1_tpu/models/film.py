@@ -1,0 +1,40 @@
+"""FiLM conditioning layer (Perez et al. 2018).
+
+Re-design of `pytorch_robotics_transformer/film_efficientnet/film_conditioning_layer.py:23-50`:
+two zero-initialized projections of the conditioning vector produce per-channel
+(γ, β); output is `(1 + γ) · F + β`. Zero init keeps a pretrained backbone's function
+unchanged at initialization (reference comment at `:29-34`).
+
+NHWC: features are (..., H, W, C); conditioning is (..., D) with matching leading dims.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class FilmConditioning(nn.Module):
+    num_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, conv_filters: jnp.ndarray, conditioning: jnp.ndarray) -> jnp.ndarray:
+        proj_add = nn.Dense(
+            self.num_channels,
+            kernel_init=nn.initializers.zeros,
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="projection_add",
+        )(conditioning)
+        proj_mult = nn.Dense(
+            self.num_channels,
+            kernel_init=nn.initializers.zeros,
+            bias_init=nn.initializers.zeros,
+            dtype=self.dtype,
+            name="projection_mult",
+        )(conditioning)
+        # Broadcast (B, C) → (B, 1, 1, C) over spatial dims (NHWC).
+        proj_add = proj_add[..., None, None, :]
+        proj_mult = proj_mult[..., None, None, :]
+        return (1.0 + proj_mult) * conv_filters + proj_add

@@ -1,0 +1,62 @@
+"""RT-1 contextual image tokenizer.
+
+Re-design of `pytorch_robotics_transformer/tokenizers/image_tokenizer.py:31-85`
+(`RT1ImageTokenizer`): fold time into batch, run the FiLM-EfficientNet encoder to a
+spatial feature map, then either TokenLearner → `num_tokens` tokens per frame or
+flatten the spatial map (h·w tokens, 100 at the B3-native 300×300 input;
+`tokens_per_context_image` at `:44-50`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rt1_tpu.models.encoder import EfficientNetEncoder
+from rt1_tpu.models.token_learner import TokenLearner
+
+
+def tokens_per_context_image(
+    use_token_learner: bool, num_tokens: int, feature_hw: int = 100
+) -> int:
+    """Static token count per frame (image_tokenizer.py:44-50)."""
+    return num_tokens if use_token_learner else feature_hw
+
+
+class RT1ImageTokenizer(nn.Module):
+    embedding_output_dim: int = 512
+    use_token_learner: bool = True
+    num_tokens: int = 8
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        image: jnp.ndarray,
+        context: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ) -> jnp.ndarray:
+        """image: (B, T, H, W, 3); context: (B, T, D) (constant along T).
+
+        Returns (B, T, num_tokens_per_frame, embedding_output_dim).
+        """
+        b, t, h, w, c = image.shape
+        image = image.reshape(b * t, h, w, c)
+        if context is not None:
+            context = context.reshape(b * t, -1)
+        feats = EfficientNetEncoder(
+            token_embedding_size=self.embedding_output_dim,
+            early_film=True,
+            pooling=False,
+            dtype=self.dtype,
+            name="encoder",
+        )(image, context=context, train=train)  # (B*T, h', w', E)
+        if self.use_token_learner:
+            tokens = TokenLearner(
+                num_tokens=self.num_tokens, dtype=self.dtype, name="token_learner"
+            )(feats, train=train)  # (B*T, num_tokens, E)
+            return tokens.reshape(b, t, self.num_tokens, self.embedding_output_dim)
+        fh, fw = feats.shape[1], feats.shape[2]
+        return feats.reshape(b, t, fh * fw, self.embedding_output_dim)

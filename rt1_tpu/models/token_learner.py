@@ -1,0 +1,40 @@
+"""TokenLearner (Ryoo et al. 2021).
+
+Re-design of `pytorch_robotics_transformer/tokenizers/token_learner.py:26-95`
+(`TokenLearnerModule`): LayerNorm over channels → 1×1 conv to a bottleneck (64) →
+tanh-approximate GELU → 1×1 conv to `num_tokens` attention maps → softmax over h·w →
+weighted spatial pooling producing `num_tokens` tokens per image.
+
+NHWC in (B, H, W, C); out (B, num_tokens, C). The weighted pooling is a single
+einsum — batched matmul on the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TokenLearner(nn.Module):
+    num_tokens: int = 8
+    bottleneck_dim: int = 64
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        b, h, w, c = inputs.shape
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(inputs)
+        x = nn.Conv(self.bottleneck_dim, (1, 1), dtype=self.dtype, name="conv1")(x)
+        x = nn.gelu(x, approximate=True)  # reference uses GELU(approximate='tanh') (:43)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Conv(self.num_tokens, (1, 1), dtype=self.dtype, name="conv2")(x)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # (B, H, W, T) → (B, T, H*W) softmax-normalized spatial attention maps.
+        maps = x.reshape(b, h * w, self.num_tokens).transpose(0, 2, 1)
+        maps = nn.softmax(maps, axis=-1)
+        feats = inputs.reshape(b, h * w, c)
+        # (B, T, HW) @ (B, HW, C) → (B, T, C): one MXU batched matmul (reference bmm :82).
+        return jnp.einsum("bts,bsc->btc", maps, feats.astype(maps.dtype))

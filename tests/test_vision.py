@@ -1,0 +1,146 @@
+"""Vision stack tests: FiLM, EfficientNet-B3, encoder, TokenLearner, image tokenizer.
+
+Mirrors reference coverage in `film_efficientnet_encoder_test.py`,
+`pretrained_efficientnet_encoder_test.py:46-86`, `token_learner_test.py:28-39`,
+`image_tokenizer_test.py:30-46` (shape + FiLM-zero-init behavioral checks; the
+pretrained-'tabby' golden test needs ImageNet blobs absent from this image — the
+zero-init invariance test below proves the same property structurally).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rt1_tpu.models.efficientnet import EfficientNet, EfficientNetB3, round_filters, round_repeats
+from rt1_tpu.models.encoder import EfficientNetEncoder
+from rt1_tpu.models.film import FilmConditioning
+from rt1_tpu.models.image_tokenizer import RT1ImageTokenizer
+from rt1_tpu.models.token_learner import TokenLearner
+
+# A tiny EfficientNet (width/depth 0.1 → minimum channels, 7 blocks) for fast CPU tests.
+TINY = dict(width_coefficient=0.1, depth_coefficient=0.1, dropout_rate=0.1)
+
+
+def test_round_filters_b3():
+    # B3 widths: stem 40, stage outs 24,32,48,96,136,232,384, top 1536.
+    assert round_filters(32, 8, 1.2) == 40
+    assert [round_filters(c, 8, 1.2) for c in (16, 24, 40, 80, 112, 192, 320)] == [
+        24, 32, 48, 96, 136, 232, 384]
+    assert round_filters(1280, 8, 1.2) == 1536
+
+
+def test_round_repeats_b3_block_count():
+    reps = [round_repeats(r, 1.4) for r in (1, 2, 2, 3, 3, 4, 1)]
+    assert sum(reps) == 26  # 26 MBConv blocks in B3 (SURVEY §2.1)
+    cfgs = EfficientNetB3().block_configs()
+    assert len(cfgs) == 26
+    # drop rate increases linearly from 0 (reference :303).
+    assert cfgs[0]["drop_rate"] == 0.0
+    assert cfgs[-1]["drop_rate"] == pytest.approx(0.2 * 25 / 26)
+
+
+def test_film_zero_init_is_identity(rng):
+    film = FilmConditioning(num_channels=8)
+    x = jax.random.normal(rng, (2, 4, 4, 8))
+    ctx = jax.random.normal(jax.random.fold_in(rng, 1), (2, 512))
+    params = film.init(rng, x, ctx)
+    out = film.apply(params, x, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+
+def test_film_efficientnet_matches_plain_at_init(rng):
+    """FiLM layers are zero-init ⇒ conditioned net ≡ unconditioned net at init.
+
+    This is the structural content of the reference's pretrained-weights golden test
+    (film_efficientnet_encoder_test.py:54-80): adding FiLM must not change function.
+    """
+    img = jax.random.uniform(rng, (1, 64, 64, 3))
+    ctx = jax.random.normal(jax.random.fold_in(rng, 1), (1, 512))
+    plain = EfficientNet(**TINY, include_top=True, classes=10, include_film=False)
+    filmed = EfficientNet(**TINY, include_top=True, classes=10, include_film=True)
+    p1 = plain.init(rng, img, train=False)
+    p2 = filmed.init(rng, img, context=ctx, train=False)
+    # Graft the plain params into the filmed net (FiLM params stay zero).
+    merged = jax.tree_util.tree_map(lambda x: x, p2)
+    flat1 = flax_flatten(p1)
+    flat2 = flax_flatten(merged)
+    for k, v in flat1.items():
+        assert k in flat2, k
+        flat2[k] = v
+    merged = flax_unflatten(flat2)
+    out_plain = plain.apply(p1, img, train=False)
+    out_filmed = filmed.apply(merged, img, context=ctx, train=False)
+    np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_filmed), atol=1e-5)
+
+
+def flax_flatten(tree):
+    from flax.traverse_util import flatten_dict
+
+    return dict(flatten_dict(tree))
+
+
+def flax_unflatten(flat):
+    from flax.traverse_util import unflatten_dict
+
+    return unflatten_dict(flat)
+
+
+def test_efficientnet_feature_map_shape(rng):
+    """No-top output is (B, ceil(H/32), ceil(W/32), top_ch)."""
+    net = EfficientNet(**TINY, include_top=False)
+    img = jnp.zeros((1, 64, 96, 3))
+    params = net.init(rng, img, train=False)
+    out = net.apply(params, img, train=False)
+    assert out.shape == (1, 2, 3, round_filters(1280, 8, 0.1))
+
+
+@pytest.mark.slow
+def test_encoder_pooling_and_map(rng):
+    enc = EfficientNetEncoder(token_embedding_size=32, pooling=False)
+    img = jnp.zeros((1, 64, 64, 3))
+    ctx = jnp.zeros((1, 512))
+    variables = enc.init(rng, img, ctx, train=False)
+    out = enc.apply(variables, img, ctx, train=False)
+    assert out.shape == (1, 2, 2, 32)
+    pooled = EfficientNetEncoder(token_embedding_size=32, pooling=True)
+    out2 = pooled.apply(variables, img, ctx, train=False)
+    assert out2.shape == (1, 32)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out).mean(axis=(1, 2)), rtol=1e-5)
+
+
+def test_token_learner_shapes(rng):
+    tl = TokenLearner(num_tokens=8)
+    x = jax.random.normal(rng, (3, 10, 10, 16))
+    params = tl.init(rng, x)
+    out = tl.apply(params, x)
+    assert out.shape == (3, 8, 16)
+
+
+def test_token_learner_weights_sum_to_one(rng):
+    """Constant feature maps must be preserved exactly (softmax weights sum to 1)."""
+    tl = TokenLearner(num_tokens=4)
+    x = jnp.full((2, 6, 6, 5), 3.5)
+    params = tl.init(rng, x)
+    out = tl.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), 3.5, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_image_tokenizer_shapes_b3(rng):
+    tok = RT1ImageTokenizer(embedding_output_dim=512, use_token_learner=True, num_tokens=8)
+    img = jnp.zeros((1, 2, 64, 64, 3))
+    ctx = jnp.zeros((1, 2, 512))
+    variables = tok.init(rng, img, ctx, train=False)
+    out = tok.apply(variables, img, ctx, train=False)
+    assert out.shape == (1, 2, 8, 512)
+
+
+@pytest.mark.slow
+def test_image_tokenizer_no_token_learner(rng):
+    tok = RT1ImageTokenizer(embedding_output_dim=64, use_token_learner=False)
+    img = jnp.zeros((1, 1, 64, 96, 3))
+    ctx = jnp.zeros((1, 1, 512))
+    variables = tok.init(rng, img, ctx, train=False)
+    out = tok.apply(variables, img, ctx, train=False)
+    assert out.shape == (1, 1, 2 * 3, 64)  # h'·w' spatial tokens (reference :80-85)
