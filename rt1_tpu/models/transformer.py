@@ -1,0 +1,138 @@
+"""Causal decoder-only transformer for RT-1.
+
+Re-design of `pytorch_robotics_transformer/transformer.py`. Architectural parity
+(verified by tests/test_transformer.py):
+
+* token embedding: Dense(input_emb → d_model) (`transformer.py:171,181`);
+* learned positional embedding over `max_seq_len=256` positions (`:172,183-186`);
+* N pre-norm blocks (`_TransformerLayer:112-144`): LN → TF-Keras-style MHA where the
+  per-head width `key_dim` is decoupled from `d_model` (`TF_MultiHeadAttention:29-79`)
+  → residual; LN → a *single* Dense(d_model → d_model) with NO activation (a quirk of
+  the reference, `:126,140-141` — kept for parity) → dropout → residual;
+* output head: Dense(d_model → vocab) (`:173,197`).
+
+Naming note carried over from the reference: `layer_size` is the per-head attention
+width (key_dim) and `feed_forward_size` is d_model (`transformer.py:115-117`).
+
+TPU-first details: attention is two einsums (MXU-shaped), the additive mask is
+prepared once outside jit, softmax in fp32 even under bf16 compute, and dropout on
+attention probabilities matches the reference's placement (`transformer.py:94-98`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+class TFMultiHeadAttention(nn.Module):
+    """tf.keras-style MHA: qkv project d_model → heads·key_dim, out back to d_model."""
+
+    num_heads: int
+    key_dim: int
+    d_model: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        b, s, _ = x.shape
+        h, k = self.num_heads, self.key_dim
+        q = nn.Dense(h * k, dtype=self.dtype, name="query")(x).reshape(b, s, h, k)
+        kk = nn.Dense(h * k, dtype=self.dtype, name="key")(x).reshape(b, s, h, k)
+        v = nn.Dense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
+        # (b, h, sq, sk) attention logits; fp32 softmax for stability under bf16.
+        logits = jnp.einsum("bshd,bthd->bhst", q, kk, preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.asarray(k, jnp.float32))
+        if mask is not None:
+            # mask: (s, s) or (b, s, s); nonzero = attend, 0 = blocked (reference :89-92).
+            if mask.ndim == 2:
+                mask = mask[None, None]
+            elif mask.ndim == 3:
+                mask = mask[:, None]  # add head axis
+            logits = jnp.where(mask.astype(bool), logits, NEG_INF)
+        probs = nn.softmax(logits.astype(jnp.float32), axis=-1)
+        probs = nn.Dropout(self.dropout_rate, deterministic=not train)(probs)
+        out = jnp.einsum("bhst,bthd->bshd", probs.astype(self.dtype), v)
+        out = out.reshape(b, s, h * k)
+        return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), probs
+
+
+class TransformerLayer(nn.Module):
+    """Pre-norm block: x + MHA(LN(x)); x + Dropout(Dense(LN(x))) (reference :130-144)."""
+
+    key_dim: int
+    num_heads: int
+    d_model: int
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        y = nn.LayerNorm(dtype=self.dtype, name="norm_1")(x)
+        attn_out, scores = TFMultiHeadAttention(
+            num_heads=self.num_heads,
+            key_dim=self.key_dim,
+            d_model=self.d_model,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="attn",
+        )(y, mask=mask, train=train)
+        x = x + attn_out
+        y = nn.LayerNorm(dtype=self.dtype, name="norm_2")(x)
+        y = nn.Dense(self.d_model, dtype=self.dtype, name="ff")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + y, scores
+
+
+class CausalTransformer(nn.Module):
+    """Token-in, vocab-logits-out decoder (reference `Transformer:146-198`)."""
+
+    num_layers: int = 8
+    key_dim: int = 128          # "layer_size" in the reference
+    num_heads: int = 8
+    d_model: int = 512          # "feed_forward_size" in the reference
+    dropout_rate: float = 0.1
+    vocab_size: int = 256
+    max_seq_len: int = 256
+    return_attention_scores: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
+        """inputs: (b, s, input_emb) → logits (b, s, vocab_size)."""
+        b, s, _ = inputs.shape
+        if s > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_seq_len={self.max_seq_len}"
+            )
+        x = nn.Dense(self.d_model, dtype=self.dtype, name="token_emb")(inputs)
+        pos_emb = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype, name="position_emb")(
+            jnp.arange(s)
+        )
+        x = x + pos_emb[None, :, :]
+        scores = []
+        for i in range(self.num_layers):
+            x, sc = TransformerLayer(
+                key_dim=self.key_dim,
+                num_heads=self.num_heads,
+                d_model=self.d_model,
+                dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
+                name=f"layer_{i}",
+            )(x, mask=attention_mask, train=train)
+            if self.return_attention_scores:
+                scores.append(sc)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype, name="output_tokens")(x)
+        if self.return_attention_scores:
+            return logits, scores
+        return logits
