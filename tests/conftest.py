@@ -14,6 +14,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The environment's sitecustomize imports jax at interpreter startup (before this
+# conftest runs), so JAX_PLATFORMS from os.environ is already captured — override the
+# live config too, or tests silently dispatch op-by-op to the remote TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
