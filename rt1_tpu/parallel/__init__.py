@@ -1,0 +1,28 @@
+"""SPMD parallelism: device meshes, sharding rules, and distributed helpers.
+
+This package is the TPU-native replacement for the reference's two comm backends
+(SURVEY.md §2.6): PyTorch-Lightning `DDPStrategy` over NCCL
+(`distribute_train.py:235`) and `jax.pmap`/`lax.pmean` with axis name "batch"
+(`language_table/train/train.py:143-151`, `bc.py:189-191`). Instead of explicit
+allreduce calls, we lay out a single `jax.sharding.Mesh` over the slice and let
+GSPMD insert XLA collectives (psum / all-gather / reduce-scatter) over ICI.
+"""
+
+from rt1_tpu.parallel.mesh import MeshConfig, make_mesh
+from rt1_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    rt1_parameter_rules,
+    shard_pytree,
+    sharding_for_path,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "rt1_parameter_rules",
+    "shard_pytree",
+    "sharding_for_path",
+]

@@ -1,0 +1,65 @@
+"""Device-mesh construction.
+
+The reference's device topology is implicit (one process per GPU, NCCL ring under
+Lightning DDP, `distribute_train.py:194,235`). On TPU the topology is explicit: a
+`jax.sharding.Mesh` over the slice, with named axes that sharding specs refer to.
+
+Axis conventions used throughout rt1_tpu:
+
+* ``data``  — data parallelism (batch axis). Gradient reduction becomes an XLA
+  psum over ICI, replacing DDP's NCCL bucket allreduce.
+* ``model`` — tensor parallelism (attention heads / FFN columns).
+* ``seq``   — sequence/context parallelism (ring attention); unused for the 66-token
+  RT-1 window (SURVEY.md §5 "long-context: absent") but first-class in the API so
+  long-horizon variants can turn it on.
+
+All axes are optional; size-1 axes are free (no collectives are emitted for them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. -1 for `data` means "all remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.model * self.seq
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by model*seq={fixed}"
+            )
+        data = self.data if self.data != -1 else n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.model}x{self.seq} != {n_devices} devices"
+            )
+        return MeshConfig(data=data, model=self.model, seq=self.seq)
+
+
+def make_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ('data', 'seq', 'model') mesh over `devices` (default: all).
+
+    Axis order puts ``model`` innermost so tensor-parallel collectives ride the
+    fastest ICI links (nearest-neighbor on a TPU slice), ``data`` outermost so DP
+    psum tolerates the slower hops (and DCN across hosts on multi-host slices,
+    where `jax.devices()` is already ordered host-major).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    cfg = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(cfg.data, cfg.seq, cfg.model)
+    return Mesh(arr, axis_names=("data", "seq", "model"))

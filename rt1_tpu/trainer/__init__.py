@@ -1,0 +1,22 @@
+"""SPMD training harness.
+
+TPU-native replacement for the reference's two trainers (SURVEY.md §2.2, §3.4):
+`RT1_Lightning` + DDP (`distribute_train.py:19-247`) and the vendored JAX
+`pmap`/`pmean` loop (`language_table/train/train.py:60-218`). One `jit`-compiled
+train step with explicit shardings over a `Mesh` replaces both — gradient
+reduction is a GSPMD-inserted psum over ICI, not an NCCL allreduce and not an
+explicit `lax.pmean`.
+"""
+
+from rt1_tpu.trainer.optim import make_optimizer, multistep_lr
+from rt1_tpu.trainer.state import TrainState, create_train_state
+from rt1_tpu.trainer.train import TrainStepFns, make_train_step_fns
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_optimizer",
+    "multistep_lr",
+    "TrainStepFns",
+    "make_train_step_fns",
+]

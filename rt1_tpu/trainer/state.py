@@ -1,0 +1,62 @@
+"""Functional train state.
+
+Replaces the mutable module + optimizer of `RT1_Lightning` (`distribute_train.py:
+19-110`) and Stack B's `TrainState` flax struct (`language_table/train/bc.py:33-40`:
+step/params/opt_state/batch_stats/norm_info). Ours carries step, params,
+batch_stats (EfficientNet BatchNorm running stats — SURVEY.md §7 hard-part 2), and
+opt_state. Under pjit/GSPMD, BatchNorm's batch-mean over the sharded batch axis is
+itself a global collective, so no explicit cross-replica `merge_batch_stats`
+(`train.py:258-266`) is needed — stats are identical on every shard by
+construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray                    # scalar int32
+    params: Any
+    batch_stats: Any                     # {} when the model has no BatchNorm
+    opt_state: Any
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any, new_batch_stats: Optional[Any] = None) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            batch_stats=self.batch_stats if new_batch_stats is None else new_batch_stats,
+            opt_state=new_opt_state,
+        )
+
+
+def create_train_state(
+    model: Any,
+    rng: jax.Array,
+    example_batch: Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]],
+    tx: optax.GradientTransformation,
+    init_fn: Optional[Callable] = None,
+) -> TrainState:
+    """Initialize params (+ batch_stats) from an example (observations, actions)."""
+    obs, actions = example_batch
+    if init_fn is None:
+        variables = model.init({"params": rng, "crop": rng}, obs, actions, train=False)
+    else:
+        variables = init_fn(model, rng, obs, actions)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        tx=tx,
+    )

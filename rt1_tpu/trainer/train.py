@@ -1,0 +1,171 @@
+"""The jitted SPMD train/eval step.
+
+Replaces (SURVEY.md §3.1/§3.4):
+* `RT1_Lightning.training_step` + Lightning/DDP backward with NCCL bucket
+  allreduce (`distribute_train.py:59-73` + `:235`) — here the gradient reduction
+  over the batch axis is a GSPMD-inserted `psum` over ICI, emitted because the
+  batch is sharded over the mesh's ``data`` axis while params are replicated (or
+  sharded over ``model`` for tensor parallelism).
+* Stack B's `p_train_step = pmap(multi_train_step)` with explicit
+  `lax.pmean(grad)` (`language_table/train/train.py:143-151`, `bc.py:189-191`) —
+  no per-device leading axis, no explicit collectives, one global program.
+
+Gradient accumulation generalizes Stack B's `num_steps_per_train_iter` fori_loop
+(`train.py:36-57`): with ``accum_steps > 1`` the global batch is split into
+microbatches scanned on-device, gradients averaged, ONE optimizer update — the
+standard way to grow effective batch beyond HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rt1_tpu.parallel import sharding as shardlib
+from rt1_tpu.trainer.state import TrainState
+
+Batch = Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]
+
+
+@dataclasses.dataclass
+class TrainStepFns:
+    """Compiled step functions + the shardings they expect."""
+
+    train_step: Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict[str, jnp.ndarray]]]
+    eval_step: Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]
+    state_sharding: Any
+    batch_sharding: NamedSharding
+    mesh: Mesh
+
+    def shard_state(self, state: TrainState) -> TrainState:
+        return jax.device_put(state, self.state_sharding)
+
+    def shard_batch(self, batch: Batch) -> Batch:
+        return jax.device_put(batch, self.batch_sharding)
+
+
+def _loss_fn(model, params, batch_stats, batch: Batch, rng: jax.Array, train: bool):
+    obs, actions = batch
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    rngs = {"crop": jax.random.fold_in(rng, 0), "dropout": jax.random.fold_in(rng, 1)}
+    if train and batch_stats:
+        out, mutated = model.apply(
+            variables, obs, actions, train=True, rngs=rngs, mutable=["batch_stats"]
+        )
+        return out["loss"], (out, mutated["batch_stats"])
+    out = model.apply(variables, obs, actions, train=train, rngs=rngs if train else None)
+    return out["loss"], (out, batch_stats)
+
+
+def make_train_step_fns(
+    model: Any,
+    mesh: Mesh,
+    state: TrainState,
+    param_rules: Optional[Sequence[shardlib.Rule]] = None,
+    accum_steps: int = 1,
+    batch_axes: Tuple[str, ...] = ("data",),
+    donate: bool = True,
+) -> TrainStepFns:
+    """Build jitted train/eval steps with explicit in/out shardings.
+
+    `state` is only used to derive the sharding pytree (its structure, not its
+    values); call `fns.shard_state(state)` afterwards to place it on the mesh.
+    """
+    if param_rules is None:
+        param_rules = shardlib.rt1_parameter_rules()
+    state_sharding = shardlib.shard_pytree(state, mesh, param_rules)
+    batch_sh = NamedSharding(mesh, P(batch_axes))
+    repl = NamedSharding(mesh, P())
+
+    def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+        grad_fn = jax.value_and_grad(
+            lambda p, bs, b, r: _loss_fn(model, p, bs, b, r, train=True), has_aux=True
+        )
+
+        if accum_steps == 1:
+            (loss, (out, new_bs)), grads = grad_fn(state.params, state.batch_stats, batch, rng)
+        else:
+            # Under the reference loss scaling (mean CE / (b·t·(I+A)),
+            # transformer_network.py:314-319) the loss is inversely proportional
+            # to the *runtime* batch size, so a microbatch of b/accum yields
+            # accum× the full-batch loss/grads; one extra /accum makes
+            # accumulation exact (proof in tests/test_trainer.py).
+            ref_scale = getattr(model, "loss_scale", "mean") == "reference"
+            extra = float(accum_steps) if ref_scale else 1.0
+
+            def micro(carry, xs):
+                grads_acc, loss_acc, bs = carry
+                mb, r = xs
+                (l, (_, bs)), g = grad_fn(state.params, bs, mb, r)
+                return (
+                    jax.tree.map(jnp.add, grads_acc, g),
+                    loss_acc + l,
+                    bs,
+                ), None
+
+            def split(x):
+                return x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
+
+            micro_batches = jax.tree.map(split, batch)
+            rngs = jax.random.split(rng, accum_steps)
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss, new_bs), _ = jax.lax.scan(
+                micro, (zero_grads, jnp.zeros(()), state.batch_stats), (micro_batches, rngs)
+            )
+            grads = jax.tree.map(lambda g: g / (accum_steps * extra), grads)
+            loss = loss / (accum_steps * extra)
+            out = {"loss": loss}
+
+        new_state = state.apply_gradients(grads, new_batch_stats=new_bs)
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax_global_norm(grads),
+        }
+        if "action_loss" in out:
+            metrics["action_loss_mean"] = jnp.mean(out["action_loss"])
+        return new_state, metrics
+
+    def eval_step(state: TrainState, batch: Batch):
+        loss, (out, _) = _loss_fn(
+            model, state.params, state.batch_stats, batch, jax.random.PRNGKey(0), train=False
+        )
+        obs, actions = batch
+        labels = out["action_labels"]
+        preds = out["action_predictions"]
+        return {
+            "loss": loss,
+            "token_accuracy": jnp.mean((preds == labels).astype(jnp.float32)),
+        }
+
+    with mesh:
+        train_jit = jax.jit(
+            train_step,
+            in_shardings=(state_sharding, batch_sh, repl),
+            out_shardings=(state_sharding, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+        eval_jit = jax.jit(
+            eval_step,
+            in_shardings=(state_sharding, batch_sh),
+            out_shardings=repl,
+        )
+
+    return TrainStepFns(
+        train_step=train_jit,
+        eval_step=eval_jit,
+        state_sharding=state_sharding,
+        batch_sharding=batch_sh,
+        mesh=mesh,
+    )
+
+
+def optax_global_norm(tree: Any) -> jnp.ndarray:
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(tree))
+    )
