@@ -1,0 +1,95 @@
+"""Benchmark: flagship RT-1 train-step throughput on the attached TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config matches the reference's implied throughput baseline (SURVEY.md §6,
+`distribute_train.py:269-295`): batch 8 per chip, time_sequence_length 6,
+256×456 images, FiLM-EfficientNet-B3 + TokenLearner (8 tokens), 8-layer decoder,
+vocab 256 — i.e. one DDP rank's workload on one TPU chip. The reference publishes
+no numbers (BASELINE.md), so `vs_baseline` is the ratio against the round-1
+recorded value in BASELINE.json["published"]["train_steps_per_sec_per_chip"]
+when present, else 1.0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument("--height", type=int, default=256)
+    p.add_argument("--width", type=int, default=456)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from rt1_tpu.models.rt1 import RT1Policy
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from rt1_tpu.trainer import create_train_state, make_optimizer, make_train_step_fns
+
+    model = RT1Policy(
+        action_space=language_table_action_space(),
+        time_sequence_length=6,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    rng = jax.random.PRNGKey(0)
+    b, t = args.batch, 6
+    obs = {
+        "image": jax.random.uniform(rng, (b, t, args.height, args.width, 3)),
+        "natural_language_embedding": jax.random.normal(
+            jax.random.fold_in(rng, 1), (b, t, 512)
+        ),
+    }
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 2), (b, t)
+    )
+
+    n_chips = len(jax.devices())
+    mesh = make_mesh(MeshConfig())
+    tx = make_optimizer(steps_per_epoch=975)  # 7800 episodes / batch 8 (reference)
+    state = create_train_state(model, rng, (obs, actions), tx)
+    fns = make_train_step_fns(model, mesh, state)
+    state = fns.shard_state(state)
+    batch = fns.shard_batch((obs, actions))
+
+    for i in range(args.warmup):
+        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, 100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec_per_chip = args.steps / dt / n_chips
+    baseline = None
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f)["published"].get("train_steps_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = steps_per_sec_per_chip / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "train_steps_per_sec_per_chip",
+                "value": round(steps_per_sec_per_chip, 4),
+                "unit": "steps/s/chip",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

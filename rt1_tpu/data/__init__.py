@@ -1,0 +1,30 @@
+"""Data pipeline: episode storage, windowing, and host→device feeding.
+
+TPU-native re-design of the reference's Stack-A data path (SURVEY.md §2.3):
+`rlds_np_convert.py` (offline RLDS→numpy with USE instruction embeddings) and
+`load_np_dataset.py` (`EmbodiedIntelligenceDataset` sliding windows +
+`DecodeAndRandomResizedCrop`). Same sample distribution — pad-with-first-frame,
+every `window`-length window, random crop factor 0.95 → 456×256 — but stored as
+stacked-array `.npz` episodes (the reference re-loads a whole pickled `.npy`
+episode per sample, its I/O hot spot — SURVEY.md §7.7), streamed through tf.data
+with per-host sharding, and fed to the mesh as sharded `jax.Array`s.
+"""
+
+from rt1_tpu.data.episodes import (
+    Episode,
+    generate_synthetic_episode,
+    load_episode,
+    read_reference_episode,
+    save_episode,
+)
+from rt1_tpu.data.pipeline import WindowedEpisodeDataset, device_feeder
+
+__all__ = [
+    "Episode",
+    "save_episode",
+    "load_episode",
+    "read_reference_episode",
+    "generate_synthetic_episode",
+    "WindowedEpisodeDataset",
+    "device_feeder",
+]

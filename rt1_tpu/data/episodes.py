@@ -1,0 +1,87 @@
+"""Episode record format and storage.
+
+An episode is a dict of arrays stacked over time (T steps):
+
+* ``rgb``          (T, H, W, 3) uint8 — raw simulator frames (180×320 for
+  Language-Table, `environments/constants.py:46-47`)
+* ``action``       (T, 2) float32 — 2-D effector deltas
+* ``is_first``     (T,) bool
+* ``is_terminal``  (T,) bool
+* ``instruction``  (T, 512) float32 — USE embedding of the instruction
+  (`rlds_np_convert.py:28`), or (T, L) int32 raw encoded bytes pre-embedding
+
+Stored as one compressed-free `.npz` per episode (zero-copy mmap-able, no pickle),
+vs the reference's pickled list-of-dicts `.npy` (`rlds_np_convert.py:31`) which
+must be fully unpickled per access. `read_reference_episode` reads that legacy
+format for drop-in compatibility with already-converted datasets.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+Episode = Dict[str, np.ndarray]
+
+REQUIRED_KEYS = ("rgb", "action", "is_first", "is_terminal", "instruction")
+
+
+def validate_episode(ep: Episode) -> None:
+    for k in REQUIRED_KEYS:
+        if k not in ep:
+            raise KeyError(f"episode missing key {k!r}; has {sorted(ep)}")
+    t = ep["rgb"].shape[0]
+    for k in REQUIRED_KEYS:
+        if ep[k].shape[0] != t:
+            raise ValueError(f"{k} has {ep[k].shape[0]} steps, rgb has {t}")
+
+
+def save_episode(path: str, ep: Episode) -> None:
+    validate_episode(ep)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path, **ep)
+
+
+def load_episode(path: str) -> Episode:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def read_reference_episode(path: str) -> Episode:
+    """Read the reference's pickled list-of-step-dicts `.npy` format
+    (`rlds_np_convert.py:13-31`, consumed by `load_np_dataset.py:79-83`)."""
+    steps = np.load(path, allow_pickle=True)
+    ep = {
+        "rgb": np.stack([s["rgb"] for s in steps]).astype(np.uint8),
+        "action": np.stack([s["action"] for s in steps]).astype(np.float32),
+        "is_first": np.array([bool(s["is_first"]) for s in steps]),
+        "is_terminal": np.array([bool(s["is_terminal"]) for s in steps]),
+        "instruction": np.stack([s["instruction"] for s in steps]).astype(np.float32),
+    }
+    validate_episode(ep)
+    return ep
+
+
+def generate_synthetic_episode(
+    rng: np.random.Generator,
+    num_steps: Optional[int] = None,
+    height: int = 180,
+    width: int = 320,
+    instruction_dim: int = 512,
+) -> Episode:
+    """Random episode with the Language-Table schema, for tests and benchmarks."""
+    t = int(num_steps if num_steps is not None else rng.integers(8, 40))
+    instruction = rng.standard_normal(instruction_dim).astype(np.float32)
+    is_terminal = np.zeros(t, bool)
+    is_terminal[-1] = True
+    is_first = np.zeros(t, bool)
+    is_first[0] = True
+    return {
+        "rgb": rng.integers(0, 256, (t, height, width, 3), dtype=np.uint8),
+        "action": rng.uniform(-0.1, 0.1, (t, 2)).astype(np.float32),
+        "is_first": is_first,
+        "is_terminal": is_terminal,
+        "instruction": np.tile(instruction, (t, 1)),
+    }

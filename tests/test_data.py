@@ -1,0 +1,154 @@
+"""Data pipeline tests: storage round-trip, reference-format compat, window/pad
+semantics (`load_np_dataset.py:49-116` parity), loaders, device feeding."""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data import (
+    WindowedEpisodeDataset,
+    device_feeder,
+    generate_synthetic_episode,
+    load_episode,
+    read_reference_episode,
+    save_episode,
+)
+
+W = 6
+
+
+@pytest.fixture
+def episode_dir(tmp_path, np_rng):
+    lens = [8, 12, 7]
+    paths = []
+    for i, t in enumerate(lens):
+        ep = generate_synthetic_episode(np_rng, num_steps=t, height=36, width=64)
+        p = str(tmp_path / f"episode_{i}.npz")
+        save_episode(p, ep)
+        paths.append(p)
+    return paths, lens
+
+
+def test_save_load_roundtrip(tmp_path, np_rng):
+    ep = generate_synthetic_episode(np_rng, num_steps=5)
+    p = str(tmp_path / "e.npz")
+    save_episode(p, ep)
+    back = load_episode(p)
+    for k in ep:
+        np.testing.assert_array_equal(ep[k], back[k])
+
+
+def test_reference_format_compat(tmp_path, np_rng):
+    """Our reader consumes the reference's pickled list-of-step-dicts .npy."""
+    ep = generate_synthetic_episode(np_rng, num_steps=4, height=16, width=16)
+    steps = [
+        {
+            "rgb": ep["rgb"][i],
+            "action": ep["action"][i],
+            "is_first": bool(ep["is_first"][i]),
+            "is_terminal": bool(ep["is_terminal"][i]),
+            "instruction": ep["instruction"][i],
+        }
+        for i in range(4)
+    ]
+    p = str(tmp_path / "episode_0.npy")
+    np.save(p, np.array(steps, dtype=object), allow_pickle=True)
+    back = read_reference_episode(p)
+    np.testing.assert_array_equal(back["rgb"], ep["rgb"])
+    np.testing.assert_allclose(back["action"], ep["action"])
+    np.testing.assert_array_equal(back["is_terminal"], [False, False, False, True])
+
+
+def test_window_count_matches_reference(episode_dir):
+    """Padded length T+W-1 → exactly T windows per episode (load_np_dataset.py:65-74)."""
+    paths, lens = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, height=24, width=40)
+    assert len(ds) == sum(lens)
+
+
+def test_first_window_is_all_first_frame(episode_dir, np_rng):
+    """Window 0 of an episode sees the first step repeated W times, and only the
+    real first step keeps is_first semantics (pad copies get is_first=False,
+    load_np_dataset.py:49-63) — observable via identical frames/labels."""
+    paths, _ = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, crop_factor=None, height=36, width=64)
+    s = ds.get_window(0, np_rng)
+    img = s["observations"]["image"]
+    for j in range(1, W):
+        np.testing.assert_array_equal(img[0], img[j])
+    # Action labels all equal the first step's action.
+    act = s["actions"]["action"]
+    for j in range(1, W):
+        np.testing.assert_array_equal(act[0], act[j])
+
+
+def test_last_window_hits_terminal(episode_dir, np_rng):
+    paths, lens = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, crop_factor=None, height=36, width=64)
+    # Last window of episode 0 is index lens[0]-1; its final label is terminal.
+    s = ds.get_window(lens[0] - 1, np_rng)
+    term = s["actions"]["terminate_episode"]
+    assert term[-1] == 1
+    assert term[:-1].sum() == 0
+
+
+def test_crop_resize_shapes_and_range(episode_dir, np_rng):
+    paths, _ = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, crop_factor=0.95, height=24, width=40)
+    s = ds.get_window(3, np_rng)
+    img = s["observations"]["image"]
+    assert img.shape == (W, 24, 40, 3)
+    assert img.dtype == np.float32
+    assert 0.0 <= img.min() and img.max() <= 1.0
+
+
+def test_numpy_batches_shapes(episode_dir):
+    paths, lens = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, height=24, width=40)
+    it = ds.numpy_batches(batch_size=4, num_epochs=1, seed=1)
+    batch = next(it)
+    assert batch["observations"]["image"].shape == (4, W, 24, 40, 3)
+    assert batch["observations"]["natural_language_embedding"].shape == (4, W, 512)
+    assert batch["actions"]["terminate_episode"].shape == (4, W)
+    assert batch["actions"]["action"].shape == (4, W, 2)
+    # One epoch covers all windows (minus the dropped remainder).
+    count = 1 + sum(1 for _ in it)
+    assert count == sum(lens) // 4
+
+
+def test_process_sharding_partitions_windows(episode_dir):
+    paths, lens = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, height=24, width=40)
+    total = sum(lens)
+    seen = 0
+    for pi in range(2):
+        it = ds.numpy_batches(
+            batch_size=1, num_epochs=1, shuffle=False, process_index=pi, process_count=2
+        )
+        seen += sum(1 for _ in it)
+    assert seen == total
+
+
+def test_tf_dataset_pipeline(episode_dir):
+    tf = pytest.importorskip("tensorflow")
+    paths, _ = episode_dir
+    ds = WindowedEpisodeDataset(paths, window=W, height=24, width=40)
+    tfds = ds.as_tf_dataset(batch_size=4, repeat=True, num_parallel_calls=2)
+    batch = next(iter(tfds))
+    assert batch["observations"]["image"].shape == (4, W, 24, 40, 3)
+    assert batch["actions"]["action"].shape == (4, W, 2)
+
+
+def test_device_feeder_shards_batch(episode_dir):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+
+    paths, _ = episode_dir
+    mesh = make_mesh(MeshConfig())
+    sh = NamedSharding(mesh, P("data"))
+    ds = WindowedEpisodeDataset(paths, window=W, height=24, width=40)
+    feeder = device_feeder(ds.numpy_batches(batch_size=8, num_epochs=1), sh)
+    obs, actions = next(feeder)
+    assert obs["image"].sharding == sh
+    assert actions["action"].shape == (8, W, 2)
