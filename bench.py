@@ -63,7 +63,7 @@ def main():
 
     for i in range(args.warmup):
         state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
-    jax.block_until_ready(metrics["loss"])
+        jax.block_until_ready(metrics["loss"])
 
     t0 = time.perf_counter()
     for i in range(args.steps):

@@ -49,6 +49,11 @@ class WindowedEpisodeDataset:
         self._reader = reader
         self._cache: "collections.OrderedDict[int, ep_lib.Episode]" = collections.OrderedDict()
         self._cache_size = cache_episodes
+        # tf.data's parallel map calls get_window from multiple threads; the
+        # LRU mutations must be atomic.
+        import threading
+
+        self._cache_lock = threading.Lock()
         # Index construction mirrors `_create_samples` (load_np_dataset.py:65-74):
         # padded length T + window - 1 → exactly T windows per episode.
         self.index: List[Tuple[int, int]] = []
@@ -57,17 +62,26 @@ class WindowedEpisodeDataset:
             self.index.extend((i, s) for s in range(t))
 
     def _episode_len(self, i: int) -> int:
+        # Read only the length, not the payload: npz members are lazy, so
+        # loading one small member avoids pulling the rgb arrays of every
+        # episode at startup. Falls back to a full read for .npy episodes.
+        path = self.paths[i]
+        if path.endswith(".npz"):
+            with np.load(path) as z:
+                return int(z["is_first"].shape[0])
         return self._episode(i)["rgb"].shape[0]
 
     def _episode(self, i: int) -> ep_lib.Episode:
-        ep = self._cache.get(i)
-        if ep is None:
-            ep = self._reader(self.paths[i])
+        with self._cache_lock:
+            ep = self._cache.get(i)
+            if ep is not None:
+                self._cache.move_to_end(i)
+                return ep
+        ep = self._reader(self.paths[i])
+        with self._cache_lock:
             self._cache[i] = ep
             if len(self._cache) > self._cache_size:
                 self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(i)
         return ep
 
     def __len__(self) -> int:
@@ -226,9 +240,11 @@ def device_feeder(iterator, batch_sharding) -> Iterator:
     import jax
 
     for batch in iterator:
-        if hasattr(batch, "keys"):
-            b = batch
-        else:  # tf.data yields structures of EagerTensors
-            b = jax.tree.map(lambda x: x.numpy(), batch)
+        # tf.data yields dicts whose leaves are EagerTensors; numpy loaders
+        # yield dicts of ndarrays. Normalize leaves, not the container.
+        b = jax.tree.map(
+            lambda x: x.numpy() if hasattr(x, "numpy") else np.asarray(x),
+            batch,
+        )
         obs, actions = b["observations"], b["actions"]
         yield jax.device_put((obs, actions), batch_sharding)

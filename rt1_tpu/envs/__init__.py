@@ -1,0 +1,12 @@
+"""Language-Table board environment: geometry, blocks, rewards, simulator.
+
+TPU-native rebuild of the reference's `language_table/environments/` package
+(see SURVEY.md §2.5). The board/reward/instruction logic is pure numpy and has
+no simulator dependency; the physics backend is pluggable (kinematic numpy
+backend always available, PyBullet optional).
+"""
+
+from rt1_tpu.envs import blocks, constants, language, task_info
+from rt1_tpu.envs.language_table import LanguageTable
+
+__all__ = ["blocks", "constants", "language", "task_info", "LanguageTable"]

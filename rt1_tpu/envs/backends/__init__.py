@@ -1,0 +1,27 @@
+"""Pluggable physics backends for the Language-Table board.
+
+The reference runs exclusively on PyBullet (`language_table.py:41-42`); we
+abstract the physics so the env also runs hermetically (pure numpy) where
+PyBullet isn't installed. `make_backend("auto")` prefers PyBullet when
+importable, else the kinematic backend.
+"""
+
+from rt1_tpu.envs.backends.kinematic import KinematicBackend
+
+
+def make_backend(name="auto", **kwargs):
+    if name == "kinematic":
+        return KinematicBackend(**kwargs)
+    if name in ("auto", "pybullet"):
+        try:
+            from rt1_tpu.envs.backends.pybullet_backend import PyBulletBackend
+
+            return PyBulletBackend(**kwargs)
+        except ImportError:
+            if name == "pybullet":
+                raise
+            return KinematicBackend(**kwargs)
+    raise ValueError(f"Unknown physics backend: {name}")
+
+
+__all__ = ["KinematicBackend", "make_backend"]

@@ -1,0 +1,155 @@
+"""Pure-numpy quasi-static physics for the Language-Table board.
+
+Replaces the reference's PyBullet simulation (`language_table.py:599-646`,
+xArm IK + 24x stepSimulation per control step) with a deterministic 2-D
+quasi-static contact model: the cylindrical effector sweeps toward its target
+and pushes disc-approximated blocks out of its way; block-block overlap is
+relaxed iteratively. Blocks on a felt table have negligible momentum at
+10 Hz control, so quasi-static pushing is a good model of the real dynamics.
+
+No arm kinematics are simulated: the effector is position-controlled directly
+(the reference's IK + position control converges to the target within one
+control step anyway). This keeps the backend dependency-free and fast enough
+to run thousands of eval episodes on host CPU while the TPU runs the policy.
+"""
+
+import numpy as np
+
+from rt1_tpu.envs import constants
+
+# Object footprints (meters). The real blocks are ~4cm across, the effector
+# cylinder ~2.5cm diameter.
+EFFECTOR_RADIUS = 0.0125
+BLOCK_RADIUS = 0.02
+
+# Where off-board blocks are parked (reference casts them to (5, 5),
+# `language_table.py:883-888`).
+FAR_AWAY = np.array([5.0, 5.0])
+
+_RELAX_ITERS = 4
+
+
+class KinematicBackend:
+    """Quasi-static 2-D board physics."""
+
+    name = "kinematic"
+
+    def __init__(self, block_names=None):
+        if block_names is None:
+            from rt1_tpu.envs import blocks as blocks_module
+
+            block_names = list(blocks_module.ALL_BLOCKS)
+        self._block_names = list(block_names)
+        n = len(self._block_names)
+        self._index = {b: i for i, b in enumerate(self._block_names)}
+        self._block_xy = np.tile(FAR_AWAY, (n, 1))
+        self._block_yaw = np.zeros(n)
+        self._effector_xy = np.array(
+            [constants.CENTER_X, constants.CENTER_Y], dtype=np.float64
+        )
+        self._effector_target_xy = self._effector_xy.copy()
+
+    # -- poses ----------------------------------------------------------
+
+    @property
+    def block_names(self):
+        return list(self._block_names)
+
+    def block_pose(self, name):
+        i = self._index[name]
+        return self._block_xy[i].copy(), float(self._block_yaw[i])
+
+    def set_block_pose(self, name, xy, yaw=0.0):
+        i = self._index[name]
+        self._block_xy[i] = np.asarray(xy, dtype=np.float64)
+        self._block_yaw[i] = float(yaw)
+
+    def park_block(self, name):
+        self.set_block_pose(name, FAR_AWAY, 0.0)
+
+    def effector_xy(self):
+        return self._effector_xy.copy()
+
+    def effector_target_xy(self):
+        return self._effector_target_xy.copy()
+
+    def teleport_effector(self, xy):
+        self._effector_xy = np.asarray(xy, dtype=np.float64).copy()
+        self._effector_target_xy = self._effector_xy.copy()
+
+    def set_effector_target(self, xy):
+        self._effector_target_xy = np.asarray(xy, dtype=np.float64).copy()
+
+    # -- stepping -------------------------------------------------------
+
+    def step(self, n_substeps=24):
+        """Advance one control period: sweep effector to target, push blocks."""
+        start = self._effector_xy
+        end = self._effector_target_xy
+        for k in range(1, n_substeps + 1):
+            self._effector_xy = start + (end - start) * (k / n_substeps)
+            self._resolve_contacts()
+        # Eliminate residual drift so repeated zero-actions are stable.
+        self._effector_xy = end.copy()
+        self._resolve_contacts()
+
+    def stabilize(self, nsteps=100):
+        """Quasi-static model has no residual dynamics; just settle contacts."""
+        del nsteps
+        self._resolve_contacts()
+
+    def _resolve_contacts(self):
+        xy = self._block_xy
+        # Effector -> block pushout.
+        delta = xy - self._effector_xy
+        dist = np.linalg.norm(delta, axis=1)
+        min_sep = EFFECTOR_RADIUS + BLOCK_RADIUS
+        hit = dist < min_sep
+        if hit.any():
+            # Push along the contact normal to exactly touching; blocks
+            # sitting exactly on the effector center get a fixed normal.
+            normal = np.where(
+                dist[:, None] > 1e-9, delta / np.maximum(dist, 1e-9)[:, None],
+                np.array([1.0, 0.0]),
+            )
+            xy[hit] = self._effector_xy + normal[hit] * min_sep
+            # Pushed blocks rotate slightly toward the push direction,
+            # approximating the frictional spin of a real shove.
+            spin = np.arctan2(normal[hit][:, 1], normal[hit][:, 0])
+            self._block_yaw[hit] += 0.02 * np.sin(
+                spin - self._block_yaw[hit]
+            )
+        # Block <-> block overlap relaxation.
+        for _ in range(_RELAX_ITERS):
+            moved = False
+            for i in range(len(xy)):
+                d = xy - xy[i]
+                dd = np.linalg.norm(d, axis=1)
+                close = (dd < 2 * BLOCK_RADIUS) & (dd > 0)
+                for j in np.flatnonzero(close):
+                    n = d[j] / max(dd[j], 1e-9)
+                    push = (2 * BLOCK_RADIUS - dd[j]) / 2
+                    xy[i] -= n * push
+                    xy[j] += n * push
+                    moved = True
+            if not moved:
+                break
+
+    # -- state save/restore --------------------------------------------
+
+    def get_state(self):
+        """Deep-copied snapshot; `set_state` restores it bit-for-bit."""
+        return {
+            "block_xy": self._block_xy.copy(),
+            "block_yaw": self._block_yaw.copy(),
+            "effector_xy": self._effector_xy.copy(),
+            "effector_target_xy": self._effector_target_xy.copy(),
+        }
+
+    def set_state(self, state):
+        self._block_xy = np.array(state["block_xy"], dtype=np.float64)
+        self._block_yaw = np.array(state["block_yaw"], dtype=np.float64)
+        self._effector_xy = np.array(state["effector_xy"], dtype=np.float64)
+        self._effector_target_xy = np.array(
+            state["effector_target_xy"], dtype=np.float64
+        )

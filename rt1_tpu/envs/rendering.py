@@ -1,0 +1,154 @@
+"""Top-down board renderer (PIL) + instruction overlay.
+
+Replaces the reference's PyBullet TINY_RENDERER camera render
+(`language_table.py:579-597`) and cv2 text overlay (`:1000-1029`) with a
+dependency-light orthographic render of the board: colored block shapes,
+effector, and workspace. The visual domain is consistent between data
+collection and eval within this framework (pixel parity with PyBullet's
+perspective render is impossible without PyBullet).
+"""
+
+import textwrap
+
+import numpy as np
+from PIL import Image, ImageDraw, ImageFont
+
+from rt1_tpu.envs import constants
+
+BOARD_COLOR = (90, 90, 95)
+BORDER_COLOR = (50, 50, 55)
+EFFECTOR_COLOR = (20, 20, 20)
+EFFECTOR_RING = (230, 230, 230)
+
+BLOCK_COLORS = {
+    "red": (205, 60, 50),
+    "blue": (60, 90, 205),
+    "green": (60, 160, 70),
+    "yellow": (230, 200, 50),
+    "purple": (140, 60, 200),
+}
+
+# Margin of world space drawn around the workspace (meters).
+_MARGIN = 0.02
+
+
+def _world_to_px(xy, image_size):
+    """Map board (x, y) to pixel (col, row). x spans image rows (top=X_MIN)."""
+    h, w = image_size
+    x, y = xy
+    row = (x - (constants.X_MIN - _MARGIN)) / (
+        (constants.X_MAX - constants.X_MIN) + 2 * _MARGIN
+    ) * h
+    col = (y - (constants.Y_MIN - _MARGIN)) / (
+        (constants.Y_MAX - constants.Y_MIN) + 2 * _MARGIN
+    ) * w
+    return col, row
+
+
+def _scale(image_size):
+    """Pixels per meter (row axis)."""
+    h, _ = image_size
+    return h / ((constants.X_MAX - constants.X_MIN) + 2 * _MARGIN)
+
+
+def _shape_points(shape, yaw, radius):
+    """Unit outline for a block shape, rotated by yaw, scaled to radius."""
+    if shape == "cube":
+        angles = np.array([0.25, 0.75, 1.25, 1.75]) * np.pi
+        pts = np.stack([np.cos(angles), np.sin(angles)], -1) * 1.25
+    elif shape == "pentagon":
+        angles = np.linspace(0, 2 * np.pi, 5, endpoint=False) - np.pi / 2
+        pts = np.stack([np.cos(angles), np.sin(angles)], -1) * 1.2
+    elif shape == "star":
+        angles = np.linspace(0, 2 * np.pi, 10, endpoint=False) - np.pi / 2
+        radii = np.where(np.arange(10) % 2 == 0, 1.45, 0.62)
+        pts = np.stack([np.cos(angles), np.sin(angles)], -1) * radii[:, None]
+    elif shape == "moon":
+        # Crescent: approximated by an outer arc + offset inner arc.
+        outer = np.linspace(-0.75 * np.pi, 0.75 * np.pi, 12)
+        inner = np.linspace(0.6 * np.pi, -0.6 * np.pi, 12)
+        pts = np.concatenate([
+            np.stack([np.cos(outer), np.sin(outer)], -1) * 1.25,
+            np.stack([np.cos(inner) * 0.85 + 0.45, np.sin(inner) * 0.85], -1),
+        ])
+    elif shape == "pole":
+        pts = np.array(
+            [[-0.5, -1.6], [0.5, -1.6], [0.5, 1.6], [-0.5, 1.6]]
+        )
+    else:
+        angles = np.linspace(0, 2 * np.pi, 12, endpoint=False)
+        pts = np.stack([np.cos(angles), np.sin(angles)], -1)
+    c, s = np.cos(yaw), np.sin(yaw)
+    rot = np.array([[c, -s], [s, c]])
+    return pts @ rot.T * radius
+
+
+def render_board(block_poses, effector_xy, image_size=None, goal_region=None):
+    """Render the board state to an RGB uint8 array.
+
+    Args:
+      block_poses: {block_name: (xy, yaw)} for blocks on the table.
+      effector_xy: (x, y) of the effector cylinder.
+      image_size: (height, width); defaults to the reference camera size.
+      goal_region: optional (target_xy, radius) drawn as a translucent ring.
+    """
+    if image_size is None:
+        image_size = (constants.IMAGE_HEIGHT, constants.IMAGE_WIDTH)
+    h, w = image_size
+    img = Image.new("RGB", (w, h), BORDER_COLOR)
+    draw = ImageDraw.Draw(img, "RGBA")
+
+    # Workspace surface.
+    x0, y0 = _world_to_px((constants.X_MIN, constants.Y_MIN), image_size)
+    x1, y1 = _world_to_px((constants.X_MAX, constants.Y_MAX), image_size)
+    draw.rectangle([x0, y0, x1, y1], fill=BOARD_COLOR)
+
+    px_per_m = _scale(image_size)
+
+    if goal_region is not None and goal_region[0] is not None:
+        gx, gy = _world_to_px(goal_region[0], image_size)
+        gr = goal_region[1] * px_per_m
+        draw.ellipse([gx - gr, gy - gr, gx + gr, gy + gr],
+                     outline=(0, 255, 0, 160), width=2)
+
+    from rt1_tpu.envs.backends.kinematic import BLOCK_RADIUS, EFFECTOR_RADIUS
+
+    for name, (xy, yaw) in block_poses.items():
+        color_name, shape = name.split("_")
+        color = BLOCK_COLORS.get(color_name, (128, 128, 128))
+        cx, cy = _world_to_px(xy, image_size)
+        pts = _shape_points(shape, yaw, BLOCK_RADIUS * px_per_m)
+        # world (x -> row, y -> col): point offsets are (dy -> px, dx -> py).
+        poly = [(cx + float(p[1]), cy + float(p[0])) for p in pts]
+        draw.polygon(poly, fill=color, outline=tuple(int(c * 0.6) for c in color))
+
+    ex, ey = _world_to_px(effector_xy, image_size)
+    er = EFFECTOR_RADIUS * px_per_m * 1.4
+    draw.ellipse([ex - er, ey - er, ex + er, ey + er], fill=EFFECTOR_COLOR)
+    draw.ellipse([ex - er, ey - er, ex + er, ey + er],
+                 outline=EFFECTOR_RING, width=1)
+
+    return np.asarray(img, dtype=np.uint8)
+
+
+def add_debug_info_to_image(image, info_dict):
+    """Upscale to 640x360 and draw the wrapped instruction above the frame.
+
+    Mirrors the reference overlay layout (`language_table.py:1000-1029`):
+    resize to 640x360, prepend a white strip, wrap at 35 chars.
+    """
+    img = Image.fromarray(image).resize((640, 360), Image.BILINEAR)
+    text = ""
+    if "instruction" in info_dict:
+        text = "instruction: %s" % info_dict["instruction"]
+    wrapped = textwrap.wrap(text, width=35)
+    strip_h = int(3 * int(360 * 0.08))
+    canvas = Image.new("RGB", (640, 360 + strip_h), (255, 255, 255))
+    canvas.paste(img, (0, strip_h))
+    draw = ImageDraw.Draw(canvas)
+    font = ImageFont.load_default()
+    y = 2
+    for line in wrapped:
+        draw.text((2, y), line, fill=(0, 0, 0), font=font)
+        y += 14
+    return np.asarray(canvas, dtype=np.uint8)

@@ -1,0 +1,83 @@
+"""Reward-family registry and combined instruction enumeration.
+
+Parity source: reference `language_table/environments/rewards/instructions.py`
+(aggregate generator + vocab) and the per-family modules.
+"""
+
+from rt1_tpu.envs.rewards.base import BoardReward, inside_bounds
+from rt1_tpu.envs.rewards.block2block import BlockToBlockReward
+from rt1_tpu.envs.rewards.block2block_relative import (
+    BlockToBlockRelativeLocationReward,
+)
+from rt1_tpu.envs.rewards.block2location import BlockToAbsoluteLocationReward
+from rt1_tpu.envs.rewards.block2relativelocation import (
+    BlockToRelativeLocationReward,
+)
+from rt1_tpu.envs.rewards.corner import BlockToCornerReward
+from rt1_tpu.envs.rewards.play import PlayReward
+from rt1_tpu.envs.rewards.point2block import PointToBlockReward
+from rt1_tpu.envs.rewards.separate_blocks import SeparateBlocksReward
+
+CLIP_VOCAB_SIZE = 49408
+
+REWARD_FAMILIES = {
+    "block2block": BlockToBlockReward,
+    "point2block": PointToBlockReward,
+    "block2relativelocation": BlockToRelativeLocationReward,
+    "block2absolutelocation": BlockToAbsoluteLocationReward,
+    "block2block_relative_location": BlockToBlockRelativeLocationReward,
+    "separate_blocks": SeparateBlocksReward,
+    "block1_to_corner": BlockToCornerReward,
+    "play": PlayReward,
+}
+
+
+def get_reward_factory(name):
+    return REWARD_FAMILIES[name]
+
+
+def generate_all_instructions(block_mode):
+    """All instructions across the six enumerable families, reference order."""
+    from rt1_tpu.envs.rewards import (
+        block2block,
+        block2block_relative,
+        block2location,
+        block2relativelocation,
+        point2block,
+        separate_blocks,
+    )
+
+    return (
+        block2block.generate_all_instructions(block_mode)
+        + point2block.generate_all_instructions(block_mode)
+        + block2relativelocation.generate_all_instructions(block_mode)
+        + block2location.generate_all_instructions(block_mode)
+        + block2block_relative.generate_all_instructions(block_mode)
+        + separate_blocks.generate_all_instructions(block_mode)
+    )
+
+
+def vocab_size(block_mode):
+    words = set()
+    for instruction in generate_all_instructions(block_mode):
+        words.update(instruction.split(" "))
+    return len(words)
+
+
+__all__ = [
+    "BoardReward",
+    "inside_bounds",
+    "BlockToBlockReward",
+    "PointToBlockReward",
+    "BlockToRelativeLocationReward",
+    "BlockToAbsoluteLocationReward",
+    "BlockToBlockRelativeLocationReward",
+    "SeparateBlocksReward",
+    "BlockToCornerReward",
+    "PlayReward",
+    "REWARD_FAMILIES",
+    "get_reward_factory",
+    "generate_all_instructions",
+    "vocab_size",
+    "CLIP_VOCAB_SIZE",
+]

@@ -1,0 +1,81 @@
+"""Instruction-set parity tests.
+
+Mirrors reference `language_table/environments/rewards/instructions_test.py:
+25-36`: the combined six-family instruction count per block mode is an exact
+constant. Any drift in the grammar tables breaks this.
+"""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.envs import blocks, language
+from rt1_tpu.envs import rewards as rewards_module
+
+
+@pytest.mark.parametrize(
+    "mode,expected",
+    [
+        (blocks.BlockMode.BLOCK_4, 12652),
+        (blocks.BlockMode.BLOCK_8, 30264),
+        (blocks.BlockMode.N_CHOOSE_K, 80368),
+    ],
+)
+def test_instruction_counts(mode, expected):
+    assert len(rewards_module.generate_all_instructions(mode)) == expected
+
+
+def test_vocab_size_positive():
+    assert rewards_module.vocab_size(blocks.BlockMode.BLOCK_4) > 50
+
+
+def test_block_synonyms_unique_color_and_shape():
+    on_table = list(blocks.FIXED_4)
+    syns = language.block_synonyms("red_moon", on_table)
+    # All colors/shapes unique on the 4-block board: 3 ways to refer.
+    assert syns == ["red block", "moon", "red moon"]
+
+
+def test_block_synonyms_ambiguous():
+    on_table = list(blocks.FIXED_8)
+    syns = language.block_synonyms("red_moon", on_table)
+    # Two reds and two moons on the 8-block board: only 'red moon' is valid.
+    assert syns == ["red moon"]
+
+
+def test_n_choose_k_split_sizes():
+    total = len(blocks.TRAIN_COMBINATIONS) + len(blocks.TEST_COMBINATIONS)
+    import math
+
+    expected = sum(math.comb(16, k) for k in range(4, 11))
+    assert total == expected
+    assert len(blocks.TRAIN_COMBINATIONS) == int(total * 0.9)
+
+
+def test_n_choose_k_split_deterministic():
+    # The seeded shuffle must be reproducible across runs.
+    train2, test2 = blocks._n_choose_k_combinations()
+    assert train2[:5] == blocks.TRAIN_COMBINATIONS[:5]
+    assert test2[:5] == blocks.TEST_COMBINATIONS[:5]
+
+
+def test_block2block_relative_task_ids():
+    from rt1_tpu.envs.rewards import block2block_relative as b2br
+
+    assert b2br.NUM_UNIQUE_TASKS == 16 * 16 * 8
+    # Stable sorted mapping.
+    assert (
+        b2br.UNIQUE_TASK_STRINGS["blue_cube-blue_cube-diagonal_down_left"]
+        < b2br.NUM_UNIQUE_TASKS
+    )
+
+
+def test_instruction_grammar_spot_checks():
+    insts = set(
+        rewards_module.generate_all_instructions(blocks.BlockMode.BLOCK_4)
+    )
+    assert "push the red moon to the blue cube" in insts
+    assert "point at the green star" in insts
+    assert "slide the yellow pentagon to the center" in insts
+    assert "separate the blue cube from the red moon" in insts
+    assert "move the blue cube above the red moon" in insts
+    assert "slightly push the green star up" in insts
