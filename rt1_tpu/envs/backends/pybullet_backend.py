@@ -52,6 +52,18 @@ class PyBulletBackend:  # pragma: no cover - requires pybullet + assets
             [constants.CENTER_X, constants.CENTER_Y]
         )
         self._effector_target_xy = self._effector_xy.copy()
+        # Kinematic effector cylinder (no arm URDF needed): a zero-mass body
+        # teleported along the sweep each substep; pybullet's contact
+        # resolution shoves blocks out of penetration, approximating the
+        # reference's position-controlled cylinder end effector.
+        col = self._client.createCollisionShape(
+            pybullet.GEOM_CYLINDER, radius=0.0125, height=0.08
+        )
+        self._effector_id = self._client.createMultiBody(
+            baseMass=0,
+            baseCollisionShapeIndex=col,
+            basePosition=[self._effector_xy[0], self._effector_xy[1], 0.04],
+        )
 
     @property
     def block_names(self):
@@ -82,12 +94,21 @@ class PyBulletBackend:  # pragma: no cover - requires pybullet + assets
     def teleport_effector(self, xy):
         self._effector_xy = np.asarray(xy, dtype=np.float64).copy()
         self._effector_target_xy = self._effector_xy.copy()
+        self._place_effector(self._effector_xy)
 
     def set_effector_target(self, xy):
         self._effector_target_xy = np.asarray(xy, dtype=np.float64).copy()
 
+    def _place_effector(self, xy):
+        self._client.resetBasePositionAndOrientation(
+            self._effector_id, [xy[0], xy[1], 0.04], [0, 0, 0, 1]
+        )
+
     def step(self, n_substeps=24):
-        for _ in range(n_substeps):
+        start = self._effector_xy
+        end = self._effector_target_xy
+        for k in range(1, n_substeps + 1):
+            self._place_effector(start + (end - start) * (k / n_substeps))
             self._client.stepSimulation()
         self._effector_xy = self._effector_target_xy.copy()
 

@@ -121,7 +121,10 @@ class LanguageTable:
             blocks_on_table = list(self._blocks_on_table)
 
         self._blocks_on_table = blocks_on_table
-        state = self._compute_state()
+        # On the state-restore path the task info was just restored from the
+        # snapshot; asking the (unrestored) reward for a task update would
+        # clobber it with the previous episode's task.
+        state = self._compute_state(request_task_update=reset_poses)
         self._previous_state = state
         return self._compute_observation(state=state)
 

@@ -1,0 +1,240 @@
+"""Vectorized RRT* planner over a 2-D board with circular obstacles.
+
+Parity source: reference `language_table/environments/oracles/rrt_star.py:
+25-357` (same algorithm, same tuning-parameter meanings). This version keeps
+the vertex set in growing numpy arrays so nearest-neighbor / neighborhood
+queries and segment-circle collision checks are vectorized instead of Python
+loops over node objects — the planner runs every few control steps in the
+eval loop, so host-side speed matters.
+"""
+
+import math
+
+import numpy as np
+
+
+def _segment_hits_circles(p0, p1, centers, radii):
+    """Does segment p0->p1 pass within radii of any center? Vectorized."""
+    if len(centers) == 0:
+        return False
+    d = p1 - p0
+    d2 = float(d @ d)
+    if d2 == 0.0:
+        return False
+    t = np.clip(((centers - p0) @ d) / d2, 0.0, 1.0)
+    closest = p0 + t[:, None] * d
+    dist = np.linalg.norm(closest - centers, axis=1)
+    return bool(np.any(dist <= radii))
+
+
+def _inside_circles(p, centers, radii):
+    if len(centers) == 0:
+        return False
+    return bool(np.any(np.linalg.norm(centers - p, axis=1) <= radii))
+
+
+def _inside_boundary(p, delta, x_range, y_range, boundary_width):
+    """Inside any of the four thin boundary strips (with margin delta)."""
+    x, y = p
+    x_min, x_max = x_range
+    y_min, y_max = y_range
+    w = boundary_width
+    return (
+        x <= x_min + w + delta
+        or x >= x_max - delta
+        or y <= y_min + w + delta
+        or y >= y_max - delta
+    )
+
+
+class RRTStarPlanner:
+    """RRT* over a rectangle with circular obstacles."""
+
+    def __init__(
+        self,
+        start,
+        goal,
+        x_range,
+        y_range,
+        obstacle_xy,
+        obstacle_radii,
+        delta,
+        step_length,
+        goal_sample_rate,
+        search_radius,
+        iter_max,
+        boundary_width=0.01,
+        rng=None,
+    ):
+        self.start = np.asarray(start, dtype=np.float64)
+        self.goal = np.asarray(goal, dtype=np.float64)
+        self.x_range = x_range
+        self.y_range = y_range
+        self.obstacles = (
+            np.asarray(obstacle_xy, dtype=np.float64).reshape(-1, 2)
+        )
+        # Inflate obstacle radii by delta once, up front.
+        self.radii = (
+            np.asarray(obstacle_radii, dtype=np.float64).reshape(-1) + delta
+        )
+        self.delta = delta
+        self.step_length = step_length
+        self.goal_sample_rate = goal_sample_rate
+        self.search_radius = search_radius
+        self.iter_max = iter_max
+        self.boundary_width = boundary_width
+        self.rng = rng or np.random
+        self.success = False
+        self.path = []
+
+    def _collision_free(self, p0, p1):
+        if _inside_circles(p1, self.obstacles, self.radii):
+            return False
+        if _inside_boundary(
+            p1, self.delta, self.x_range, self.y_range, self.boundary_width
+        ):
+            return False
+        return not _segment_hits_circles(p0, p1, self.obstacles, self.radii)
+
+    def plan(self):
+        """Grow the tree; on success `self.path` is goal->start subgoals."""
+        if _inside_circles(self.start, self.obstacles, self.radii):
+            # Start embedded in an obstacle: unplannable configuration.
+            self.success = False
+            return self
+
+        n_cap = self.iter_max + 2
+        pts = np.empty((n_cap, 2))
+        parent = np.full(n_cap, -1, dtype=np.int64)
+        cost = np.zeros(n_cap)
+        pts[0] = self.start
+        n = 1
+
+        for _ in range(self.iter_max):
+            if self.rng.random() > self.goal_sample_rate:
+                sample = np.array(
+                    [
+                        self.rng.uniform(
+                            self.x_range[0] + self.delta,
+                            self.x_range[1] - self.delta,
+                        ),
+                        self.rng.uniform(
+                            self.y_range[0] + self.delta,
+                            self.y_range[1] - self.delta,
+                        ),
+                    ]
+                )
+            else:
+                sample = self.goal
+
+            dists = np.linalg.norm(pts[:n] - sample, axis=1)
+            near_i = int(np.argmin(dists))
+            step = min(self.step_length, dists[near_i])
+            if dists[near_i] == 0.0:
+                continue
+            new = pts[near_i] + (sample - pts[near_i]) / dists[near_i] * step
+
+            if not self._collision_free(pts[near_i], new):
+                continue
+
+            # Neighborhood radius shrinks as the tree grows (standard RRT*).
+            r = min(
+                self.search_radius * math.sqrt(math.log(n + 1) / (n + 1)),
+                self.step_length,
+            )
+            nd = np.linalg.norm(pts[:n] - new, axis=1)
+            neighbors = [
+                j
+                for j in np.flatnonzero(nd <= r)
+                if self._collision_free(pts[j], new)
+            ]
+
+            pts[n] = new
+            if neighbors:
+                costs = [cost[j] + nd[j] for j in neighbors]
+                best = neighbors[int(np.argmin(costs))]
+                parent[n] = best
+                cost[n] = cost[best] + nd[best]
+                # Rewire: adopt the new node as parent where it shortens paths.
+                for j in neighbors:
+                    through_new = cost[n] + nd[j]
+                    if through_new < cost[j]:
+                        parent[j] = n
+                        cost[j] = through_new
+            else:
+                parent[n] = near_i
+                cost[n] = cost[near_i] + step
+            n += 1
+
+        # Connect the tree to the goal.
+        gd = np.linalg.norm(pts[:n] - self.goal, axis=1)
+        candidates = np.flatnonzero(gd <= self.step_length)
+        best_i, best_c = None, np.inf
+        for j in candidates:
+            if not self._collision_free(pts[j], self.goal):
+                continue
+            c = cost[j] + gd[j]
+            if c < best_c:
+                best_i, best_c = int(j), c
+        if best_i is None:
+            if len(candidates):
+                self.success = False
+                return self
+            # Mirror the reference's fallback: no vertex reached the goal
+            # radius; treat the most recently added vertex as the endpoint.
+            best_i = n - 1
+
+        path = [list(self.goal)]
+        node = best_i
+        while node != -1:
+            path.append([float(pts[node][0]), float(pts[node][1])])
+            node = int(parent[node])
+        self.path = path
+        self.success = True
+        return self
+
+
+def plan_shortest_path(
+    xy_start,
+    xy_goal,
+    x_range,
+    y_range,
+    obstacle_xy,
+    obstacle_widths,
+    delta,
+    step_length,
+    goal_sample_rate,
+    search_radius,
+    iter_max,
+    boundary_width=0.01,
+    rng=None,
+    raise_error_on_plan_failure=False,
+):
+    """Plan goal->start subgoal list; falls back to the direct segment.
+
+    Mirrors `rrt_star.get_shortest_path_no_collisions` (reference `:25-85`)
+    including the "just try the direct path and replan later" compromise on
+    failure.
+    """
+    planner = RRTStarPlanner(
+        xy_start,
+        xy_goal,
+        x_range,
+        y_range,
+        obstacle_xy,
+        obstacle_widths,
+        delta,
+        step_length,
+        goal_sample_rate,
+        search_radius,
+        iter_max,
+        boundary_width=boundary_width,
+        rng=rng,
+    )
+    planner.plan()
+    if not planner.success:
+        if raise_error_on_plan_failure:
+            raise ValueError("Could not find path! Consider retuning RRT-*.")
+        return [list(np.asarray(xy_goal, float)),
+                list(np.asarray(xy_start, float))], False
+    return planner.path, True

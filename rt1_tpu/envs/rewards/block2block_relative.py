@@ -136,6 +136,10 @@ class BlockToBlockRelativeLocationReward(base.BoardReward):
         self._target_translation = None
 
     def _sample_instruction(self, block, target_block, direction, blocks_on_table):
+        # NOTE: samples from the generic 4-verb push list, matching the
+        # reference (`block2block_relative_location.py:202`); the module's
+        # 5-verb VERBS list (with 'bring the') is used only for enumeration,
+        # exactly as in the reference.
         verb = self._rng.choice(language.PUSH_VERBS)
         block_syn = self._pick_synonym(block, blocks_on_table)
         target_syn = self._pick_synonym(target_block, blocks_on_table)

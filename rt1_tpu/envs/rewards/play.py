@@ -87,10 +87,12 @@ def get_100_4block_instructions(num_train_per_family=20,
                                 return_train=True):
     """20 random train (+5 test) instructions per long-horizon family."""
     train_inst, test_inst = [], []
-    random.seed(0)
+    # Local RNG seeded like the reference's `random.seed(0)` (play.py:110)
+    # without the side effect of reseeding the process-global random module.
+    rng = random.Random(0)
 
     def take(family):
-        random.shuffle(family)
+        rng.shuffle(family)
         if num_train_per_family:
             train_inst.extend(family[:num_train_per_family])
             test_inst.extend(
@@ -256,6 +258,16 @@ def get_sort_tasks():
     return ["group the blocks by color"]
 
 
+_FAMILY_CACHE = {}
+
+
+def _cached_family(fn):
+    """Families like colors_in_locations build 10k-70k strings; build once."""
+    if fn not in _FAMILY_CACHE:
+        _FAMILY_CACHE[fn] = fn()
+    return _FAMILY_CACHE[fn]
+
+
 def get_random_8block_instruction(rng):
     task_fns = [
         get_sort_tasks, colors_in_locations, group_color_pairs,
@@ -264,7 +276,7 @@ def get_random_8block_instruction(rng):
         all_blocks_in_location, k_blocks_in_location_i_rest_in_location_j,
         get_shape_instructions,
     ]
-    return rng.choice(rng.choice(task_fns)())
+    return rng.choice(_cached_family(rng.choice(task_fns)))
 
 
 class PlayReward(base.BoardReward):

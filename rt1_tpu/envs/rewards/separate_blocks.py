@@ -31,8 +31,10 @@ def _avoid_phrase(avoid_syns, n_on_table, group_syn, rng=None):
     """Render the list of blocks to move away from as one phrase.
 
     Mirrors the reference's cascading-if rendering
-    (`separate_blocks.py:52-69,113-127`) including the quirk that the
-    "all blocks together" REST case is overridden when len == 2 or 3.
+    (`separate_blocks.py:52-69,113-127`) including its quirk that the REST
+    ("rest of the blocks") assignment is always overridden by a later
+    branch (len 1-3 or >= 4 cover every case), so REST never actually
+    appears in generated instructions — behavioral parity over intent.
     """
     phrase = None
     if len(avoid_syns) == n_on_table - 1:

@@ -1,0 +1,31 @@
+"""Closed-loop evaluation harness.
+
+Parity source: reference `language_table/eval/main_rt1.py` (protocol:
+N episodes per reward family, oracle-validated inits, <=80 steps, success =
+sparse reward > 0, per-episode mp4s) and `language_table/eval/wrappers.py`
+(instruction embedding + center-crop + history wrappers).
+"""
+
+from rt1_tpu.eval.embedding import (
+    HashInstructionEmbedder,
+    TableInstructionEmbedder,
+    get_embedder,
+)
+from rt1_tpu.eval.evaluate import evaluate_policy
+from rt1_tpu.eval.policy import RT1EvalPolicy
+from rt1_tpu.eval.wrappers import (
+    CentralCropImageWrapper,
+    HistoryWrapper,
+    InstructionEmbeddingWrapper,
+)
+
+__all__ = [
+    "HashInstructionEmbedder",
+    "TableInstructionEmbedder",
+    "get_embedder",
+    "evaluate_policy",
+    "RT1EvalPolicy",
+    "CentralCropImageWrapper",
+    "HistoryWrapper",
+    "InstructionEmbeddingWrapper",
+]

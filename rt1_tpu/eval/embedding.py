@@ -1,0 +1,116 @@
+"""Instruction -> 512-d embedding providers.
+
+The reference embeds instructions with the TF-hub Universal Sentence Encoder
+both offline (`rlds_np_convert.py:48`) and at eval reset
+(`language_table/common/rt1_tokenizer.py:4-8`). TF-hub and its weights are
+not available in this image, so embedding is a pluggable provider:
+
+* `TableInstructionEmbedder` — lookup into a precomputed {instruction: vec}
+  table (the closed instruction set is enumerable, SURVEY.md §7.7), saved as
+  an .npz. This is the production path: compute the table once with USE
+  offline, ship it with the checkpoint.
+* `HashInstructionEmbedder` — deterministic seeded-Gaussian embedding per
+  instruction string. Self-contained: train-time conversion and eval use the
+  same mapping, so policies trained in this framework are consistent end to
+  end even without USE weights.
+* `UniversalSentenceEncoder` — the real TF-hub model, import-gated.
+"""
+
+import hashlib
+
+import numpy as np
+
+EMBEDDING_DIM = 512
+
+
+class HashInstructionEmbedder:
+    """Deterministic pseudo-embedding: unit Gaussian seeded by the text hash."""
+
+    name = "hash"
+
+    def __init__(self, dim=EMBEDDING_DIM):
+        self.dim = dim
+        self._cache = {}
+
+    def __call__(self, text):
+        vec = self._cache.get(text)
+        if vec is None:
+            digest = hashlib.sha256(text.encode("utf-8")).digest()
+            seed = int.from_bytes(digest[:8], "little") % (2**32)
+            rng = np.random.RandomState(seed)
+            vec = rng.randn(self.dim).astype(np.float32)
+            vec /= np.linalg.norm(vec)
+            self._cache[text] = vec
+        return vec
+
+
+class TableInstructionEmbedder:
+    """Precomputed lookup table (npz with 'instructions' + 'embeddings')."""
+
+    name = "table"
+
+    def __init__(self, path_or_table):
+        if isinstance(path_or_table, dict):
+            self._table = dict(path_or_table)
+        else:
+            with np.load(path_or_table, allow_pickle=False) as z:
+                instructions = [str(s) for s in z["instructions"]]
+                embeddings = np.asarray(z["embeddings"], np.float32)
+            self._table = dict(zip(instructions, embeddings))
+
+    def __call__(self, text):
+        try:
+            return self._table[text]
+        except KeyError as e:
+            raise KeyError(
+                f"Instruction not in embedding table: {text!r}. Regenerate "
+                "the table over rewards.generate_all_instructions(...)"
+            ) from e
+
+    @staticmethod
+    def build(instructions, embed_fn, path=None):
+        """Precompute a table over an instruction list with any embed fn."""
+        embeddings = np.stack([embed_fn(s) for s in instructions]).astype(
+            np.float32
+        )
+        if path is not None:
+            np.savez_compressed(
+                path,
+                instructions=np.array(instructions),
+                embeddings=embeddings,
+            )
+        return TableInstructionEmbedder(
+            dict(zip(instructions, embeddings))
+        )
+
+
+class UniversalSentenceEncoder:  # pragma: no cover - needs tf-hub weights
+    """The reference's USE embedding, available when tf-hub is installed."""
+
+    name = "use"
+
+    def __init__(self, model_path="https://tfhub.dev/google/universal-sentence-encoder/4"):
+        try:
+            import tensorflow_hub as hub
+        except ImportError as e:
+            raise ImportError(
+                "UniversalSentenceEncoder requires tensorflow_hub; use the "
+                "'hash' or 'table' embedder instead."
+            ) from e
+        self._model = hub.load(model_path)
+
+    def __call__(self, text):
+        return np.asarray(self._model([text])[0], np.float32)
+
+
+def get_embedder(spec="hash"):
+    """Resolve an embedder from a spec string or pass through an instance."""
+    if callable(spec):
+        return spec
+    if spec == "hash":
+        return HashInstructionEmbedder()
+    if spec == "use":
+        return UniversalSentenceEncoder()
+    if spec.endswith(".npz"):
+        return TableInstructionEmbedder(spec)
+    raise ValueError(f"Unknown embedder spec: {spec}")

@@ -1,0 +1,147 @@
+"""Closed-loop evaluation protocol.
+
+Parity source: reference `language_table/eval/main_rt1.py:100-221`:
+per reward family build the wrapped env chain, validate each episode init by
+requiring the RRT oracle to find a plan, roll out up to `max_episode_steps`,
+count success via the sparse reward, optionally write per-episode mp4s.
+"""
+
+import collections
+import os
+
+import numpy as np
+
+from rt1_tpu.envs import LanguageTable, blocks
+from rt1_tpu.envs import rewards as rewards_module
+from rt1_tpu.envs.oracles import RRTPushOracle
+from rt1_tpu.eval.embedding import get_embedder
+from rt1_tpu.eval.wrappers import (
+    CentralCropImageWrapper,
+    HistoryWrapper,
+    InstructionEmbeddingWrapper,
+)
+
+# Default protocol constants (reference `main_rt1.py:118-119`).
+DEFAULT_REWARDS = ("block2block",)
+NUM_EVALS_PER_REWARD = 10
+MAX_EPISODE_STEPS = 80
+
+
+def build_eval_env(
+    reward_name="block2block",
+    block_mode=blocks.BlockMode.BLOCK_8,
+    seed=0,
+    embedder="hash",
+    target_height=256,
+    target_width=456,
+    random_crop_factor=0.95,
+    sequence_length=6,
+    backend="kinematic",
+):
+    """The reference env chain (`main_rt1.py:130-142`), our wrappers."""
+    env = LanguageTable(
+        block_mode=block_mode,
+        reward_factory=rewards_module.get_reward_factory(reward_name),
+        seed=seed,
+        backend=backend,
+    )
+    env = InstructionEmbeddingWrapper(env, get_embedder(embedder))
+    env = CentralCropImageWrapper(
+        env,
+        target_height=target_height,
+        target_width=target_width,
+        random_crop_factor=random_crop_factor,
+    )
+    env = HistoryWrapper(
+        env,
+        history_length=sequence_length,
+        keys=("rgb_sequence", "natural_language_embedding",
+              "effector_translation", "effector_target_translation"),
+    )
+    return env
+
+
+def run_episode(
+    env, policy, max_episode_steps=MAX_EPISODE_STEPS, collect_frames=False
+):
+    """One oracle-validated episode. Returns (success, steps, frames)."""
+    policy.reset()
+    oracle = RRTPushOracle(env, use_ee_planner=True)
+    while True:
+        obs = env.reset()
+        if oracle.get_plan(env.compute_state()):
+            break
+        # Init invalid: no collision-free plan exists; re-randomize
+        # (reference `main_rt1.py:163-172`).
+    frames = [env.render()] if collect_frames else []
+    done = False
+    steps = 0
+    while not done and steps < max_episode_steps:
+        action = policy.action(obs)
+        obs, _, done, _ = env.step(action)
+        if collect_frames:
+            frames.append(env.render())
+        steps += 1
+    return bool(env.succeeded), steps, frames
+
+
+def _write_video(path_stem, frames, fps=10):
+    """mp4 via imageio-ffmpeg when available, else animated GIF."""
+    import imageio
+
+    try:
+        imageio.mimsave(path_stem + ".mp4", frames, fps=fps)
+    except (ValueError, ImportError):
+        imageio.mimsave(path_stem + ".gif", frames, duration=1000 / fps)
+
+
+def evaluate_policy(
+    policy,
+    workdir=None,
+    reward_names=DEFAULT_REWARDS,
+    num_evals_per_reward=NUM_EVALS_PER_REWARD,
+    max_episode_steps=MAX_EPISODE_STEPS,
+    block_mode=blocks.BlockMode.BLOCK_8,
+    seed=0,
+    embedder="hash",
+    write_videos=False,
+    env_kwargs=None,
+):
+    """Full protocol over reward families; returns {reward: successes}."""
+    video_dir = None
+    if write_videos and workdir is not None:
+        video_dir = os.path.join(workdir, "videos")
+        os.makedirs(video_dir, exist_ok=True)
+
+    results = collections.defaultdict(int)
+    episode_lengths = collections.defaultdict(list)
+    for reward_name in reward_names:
+        env = build_eval_env(
+            reward_name=reward_name,
+            block_mode=block_mode,
+            seed=seed,
+            embedder=embedder,
+            **(env_kwargs or {}),
+        )
+        for ep in range(num_evals_per_reward):
+            success, steps, frames = run_episode(
+                env,
+                policy,
+                max_episode_steps=max_episode_steps,
+                collect_frames=video_dir is not None,
+            )
+            results[reward_name] += int(success)
+            episode_lengths[reward_name].append(steps)
+            if video_dir is not None:
+                tag = "success" if success else "failure"
+                _write_video(
+                    os.path.join(video_dir, f"{reward_name}_{ep}_{tag}"),
+                    frames,
+                )
+    return {
+        "successes": dict(results),
+        "episodes_per_reward": num_evals_per_reward,
+        "mean_episode_length": {
+            k: float(np.mean(v)) for k, v in episode_lengths.items()
+        },
+    }

@@ -1,0 +1,70 @@
+"""Jitted RT-1 eval policy with persistent rolling network state.
+
+Parity source: reference `language_table/train/policy.py:32-112`
+(`BCJaxPyPolicyRT1`): feed the LAST frame of the history observation,
+keep `network_state` across steps, rescale (std=1, mean=0) and clip the
+predicted delta to +/-0.03.
+
+TPU-native differences: the whole control step is ONE jitted call
+(`model.infer_step` does a single transformer pass instead of the
+reference's tokens_per_action full passes), observations are padded to
+fixed shapes so there is exactly one compile, and the network state is
+donated to avoid a device copy per step (SURVEY.md §7 hard part 3 — the
+10 Hz control loop budget).
+"""
+
+import functools
+
+import numpy as np
+
+EPS = np.finfo(np.float32).eps
+
+
+class RT1EvalPolicy:
+    """Closed-loop policy bridging env observations to the jitted model."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        action_mean=0.0,
+        action_std=1.0,
+        action_minimum=-0.03,
+        action_maximum=0.03,
+    ):
+        import jax
+
+        self._model = model
+        self._variables = variables
+        self.action_mean = action_mean
+        self.action_std = action_std
+        self.action_minimum = action_minimum
+        self.action_maximum = action_maximum
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _step(observation, state):
+            return model.apply(
+                variables, observation, state, method=model.infer_step
+            )
+
+        self._step = _step
+        self.network_state = None
+        self.reset()
+
+    def reset(self):
+        """Zero the rolling window (reference `main_rt1.py:158-160`)."""
+        self.network_state = self._model.initial_state(batch_size=1)
+
+    def action(self, observation):
+        """One control step. `observation` is the history-stacked obs dict;
+        only the last frame is consumed (reference `policy.py:65-66`)."""
+        image = observation["rgb_sequence"][-1][None]  # (1, H, W, 3)
+        embedding = observation["natural_language_embedding"][-1][None]
+        model_obs = {
+            "image": image.astype(np.float32),
+            "natural_language_embedding": embedding.astype(np.float32),
+        }
+        output, self.network_state = self._step(model_obs, self.network_state)
+        action = np.asarray(output["action"][0])
+        action = action * max(self.action_std, EPS) + self.action_mean
+        return np.clip(action, self.action_minimum, self.action_maximum)

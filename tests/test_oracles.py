@@ -1,0 +1,105 @@
+"""RRT* planner + push-oracle tests.
+
+The oracle closed-loop test is the strongest integration check in the repo:
+RRT-planned pushing must actually solve block2block episodes on the
+kinematic backend, mirroring the reference's use of the oracle for init
+validation and data collection.
+"""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.envs import LanguageTable, blocks
+from rt1_tpu.envs.oracles import RRTPushOracle, plan_shortest_path
+from rt1_tpu.envs.oracles.push_oracle import filter_subgoals
+from rt1_tpu.envs.rewards import BlockToBlockReward
+
+
+def test_rrt_plans_around_obstacle():
+    rng = np.random.RandomState(0)
+    path, success = plan_shortest_path(
+        xy_start=(0.2, -0.2),
+        xy_goal=(0.55, 0.25),
+        x_range=(0.15, 0.64),
+        y_range=(-0.34, 0.34),
+        obstacle_xy=[(0.375, 0.025)],
+        obstacle_widths=[0.03],
+        delta=0.015,
+        step_length=0.05,
+        goal_sample_rate=0.1,
+        search_radius=0.5,
+        iter_max=1024,
+        rng=rng,
+    )
+    assert success
+    # Path is goal->start.
+    np.testing.assert_allclose(path[0], (0.55, 0.25), atol=1e-9)
+    np.testing.assert_allclose(path[-1], (0.2, -0.2), atol=1e-9)
+    # Every waypoint stays clear of the inflated obstacle.
+    for p in path[1:-1]:
+        assert np.linalg.norm(np.array(p) - (0.375, 0.025)) > 0.03
+
+
+def test_rrt_direct_fallback_when_start_blocked():
+    rng = np.random.RandomState(0)
+    path, success = plan_shortest_path(
+        xy_start=(0.3, 0.0),
+        xy_goal=(0.5, 0.0),
+        x_range=(0.15, 0.64),
+        y_range=(-0.34, 0.34),
+        obstacle_xy=[(0.3, 0.001)],  # start inside this obstacle
+        obstacle_widths=[0.05],
+        delta=0.015,
+        step_length=0.05,
+        goal_sample_rate=0.1,
+        search_radius=0.5,
+        iter_max=64,
+        rng=rng,
+    )
+    assert not success
+    assert len(path) == 2  # direct goal->start compromise path
+
+
+def test_filter_subgoals_spacing():
+    path = [[0.5, 0.0], [0.49, 0.0], [0.4, 0.0], [0.39, 0.0], [0.2, 0.0]]
+    kept = filter_subgoals(list(path), 0.05)
+    # Start always kept; close-together intermediates dropped.
+    assert list(kept)[-1] == [0.2, 0.0]
+    pts = np.array(list(kept))
+    gaps = np.linalg.norm(np.diff(pts, axis=0), axis=1)
+    assert (gaps >= 0.05 - 1e-9).all()
+
+
+@pytest.mark.slow
+def test_oracle_solves_block2block_episodes():
+    env = LanguageTable(
+        block_mode=blocks.BlockMode.BLOCK_8,
+        reward_factory=BlockToBlockReward,
+        seed=7,
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=0)
+    successes = 0
+    episodes = 4
+    for _ in range(episodes):
+        env.reset()
+        oracle.reset()
+        done = False
+        for _ in range(200):
+            action = oracle.action(env.compute_state())
+            _, _, done, _ = env.step(action)
+            if done:
+                break
+        successes += int(env.succeeded)
+    assert successes >= episodes - 1, f"oracle solved {successes}/{episodes}"
+
+
+def test_oracle_plan_success_on_fresh_board():
+    env = LanguageTable(
+        block_mode=blocks.BlockMode.BLOCK_4,
+        reward_factory=BlockToBlockReward,
+        seed=11,
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=0)
+    env.reset()
+    assert oracle.get_plan(env.compute_state()) in (True, False)
+    assert oracle._current_rrt_target is not None
