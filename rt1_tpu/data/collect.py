@@ -1,0 +1,159 @@
+"""Demonstration-data collection with the scripted RRT push oracle.
+
+The reference converts Google's pre-recorded RLDS dataset
+(`rlds_np_convert.py`) — the episodes themselves were originally collected
+with the same scripted oracle it vendors. This module closes that loop
+in-framework: roll out `RRTPushOracle` on the simulator and write episodes in
+the pipeline's native format (`rt1_tpu/data/episodes.py`: action, is_first,
+is_terminal, rgb, instruction-embedding per step), so training data can be
+generated hermetically at any scale.
+
+Run:
+  python -m rt1_tpu.data.collect --data_dir /tmp/lt_data --episodes 100
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from rt1_tpu.envs import LanguageTable, blocks
+from rt1_tpu.envs import rewards as rewards_module
+from rt1_tpu.envs.oracles import RRTPushOracle
+from rt1_tpu.eval.embedding import get_embedder
+
+
+def collect_episode(
+    env,
+    oracle,
+    embedder,
+    max_steps=80,
+    image_hw=None,
+):
+    """One oracle rollout -> episode dict, or None if init/solve failed."""
+    import cv2
+
+    obs = env.reset()
+    oracle.reset()
+    if not oracle.get_plan(env.compute_state()):
+        return None
+
+    embedding = np.asarray(
+        embedder(env.instruction_str), np.float32
+    )
+    steps = {"action": [], "is_first": [], "is_terminal": [], "rgb": [],
+             "instruction": []}
+    done = False
+    t = 0
+    while not done and t < max_steps:
+        rgb = obs["rgb"]
+        if image_hw is not None:
+            rgb = cv2.resize(
+                rgb, (image_hw[1], image_hw[0]),
+                interpolation=cv2.INTER_LINEAR,
+            )
+        action = oracle.action(env.compute_state())
+        obs, _, done, _ = env.step(action)
+        steps["action"].append(np.asarray(action, np.float32))
+        steps["is_first"].append(t == 0)
+        steps["is_terminal"].append(bool(done))
+        steps["rgb"].append(rgb.astype(np.uint8))
+        steps["instruction"].append(embedding)
+        t += 1
+    if not done:
+        return None  # oracle failed; skip unsuccessful demos
+    return {k: np.stack(v) for k, v in steps.items()}
+
+
+def collect_dataset(
+    data_dir,
+    num_episodes,
+    block_mode=blocks.BlockMode.BLOCK_8,
+    reward_name="block2block",
+    seed=0,
+    max_steps=80,
+    splits=(("train", 0.975), ("val", 0.0125), ("test", 0.0125)),
+    embedder="hash",
+    image_hw=None,
+    progress_every=25,
+):
+    """Collect `num_episodes` successful demos and write split directories.
+
+    Split sizing follows the reference's 7800/100/100 proportions
+    (`rlds_np_convert.py:57-66`).
+    """
+    from rt1_tpu.data.episodes import save_episode
+
+    env = LanguageTable(
+        block_mode=block_mode,
+        reward_factory=rewards_module.get_reward_factory(reward_name),
+        seed=seed,
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=seed)
+    embed_fn = get_embedder(embedder)
+
+    counts = {name: 0 for name, _ in splits}
+    quotas = {
+        name: int(round(frac * num_episodes)) for name, frac in splits
+    }
+    # Rounding drift goes to the first (train) split.
+    first = splits[0][0]
+    quotas[first] += num_episodes - sum(quotas.values())
+    for name, _ in splits:
+        os.makedirs(os.path.join(data_dir, name), exist_ok=True)
+
+    collected = 0
+    attempts = 0
+    while collected < num_episodes:
+        attempts += 1
+        ep = collect_episode(
+            env, oracle, embed_fn, max_steps=max_steps, image_hw=image_hw
+        )
+        if ep is None:
+            continue
+        # Fill splits in order: train first, then val, then test.
+        for name, _ in splits:
+            if counts[name] < quotas[name]:
+                break
+        save_episode(
+            os.path.join(data_dir, name, f"episode_{counts[name]}.npz"), ep
+        )
+        counts[name] += 1
+        collected += 1
+        if progress_every and collected % progress_every == 0:
+            print(
+                f"collected {collected}/{num_episodes} "
+                f"({attempts} attempts)"
+            )
+    return counts
+
+
+def main(argv):
+    del argv
+    from absl import flags
+
+    FLAGS = flags.FLAGS
+    counts = collect_dataset(
+        FLAGS.data_dir,
+        FLAGS.episodes,
+        block_mode=blocks.BlockMode(FLAGS.block_mode),
+        reward_name=FLAGS.reward,
+        seed=FLAGS.seed,
+        max_steps=FLAGS.max_steps,
+        embedder=FLAGS.embedder,
+    )
+    print("done:", counts)
+
+
+if __name__ == "__main__":
+    from absl import app, flags
+
+    flags.DEFINE_string("data_dir", "/tmp/lt_data", "Output directory.")
+    flags.DEFINE_integer("episodes", 100, "Successful episodes to collect.")
+    flags.DEFINE_string("block_mode", "BLOCK_8", "Block variant.")
+    flags.DEFINE_string("reward", "block2block", "Reward family.")
+    flags.DEFINE_integer("seed", 0, "Env seed.")
+    flags.DEFINE_integer("max_steps", 80, "Max steps per episode.")
+    flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    app.run(main)

@@ -16,6 +16,7 @@ Action: (2,) float32 delta xy in [-0.1, 0.1] per 0.1s control step.
 """
 
 import collections
+import copy
 
 import numpy as np
 
@@ -217,6 +218,15 @@ class LanguageTable:
             )
         if self._instruction is not None:
             state["instruction"] = self._instruction.tolist()
+        # Snapshot the reward calculator's task internals (chosen blocks,
+        # targets, zone counters) so post-restore step()/reward() score the
+        # restored task, not whatever episode ran since.
+        if self._reward_calculator is not None:
+            state["reward_state"] = {
+                k: copy.deepcopy(v)
+                for k, v in self._reward_calculator.__dict__.items()
+                if k != "_rng"
+            }
         return state
 
     def set_board_state(self, state):
@@ -252,6 +262,10 @@ class LanguageTable:
                     (0, constants.INSTRUCTION_LENGTH - len(instruction)),
                 )
             self._instruction = np.array(instruction, dtype=np.int32)
+        if "reward_state" in state and self._reward_calculator is not None:
+            self._reward_calculator.__dict__.update(
+                copy.deepcopy(state["reward_state"])
+            )
         self.reset(reset_poses=False)
 
     # Aliases matching the reference method names.

@@ -1,0 +1,103 @@
+"""Checkpoint evaluation CLI.
+
+Parity source: reference `language_table/eval/main_rt1.py:204-221` (__main__:
+load checkpoint, run the closed-loop protocol, print per-reward successes).
+
+Run:
+  python -m rt1_tpu.eval.main --config rt1_tpu/train/configs/tiny.py \
+      --workdir /tmp/vt --rewards block2block
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def load_policy_from_workdir(config, workdir):
+    """Rebuild the model and restore the newest checkpoint into an eval policy."""
+    import jax
+    import numpy as np
+
+    from rt1_tpu.eval.policy import RT1EvalPolicy
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from rt1_tpu.train.train import build_model
+    from rt1_tpu.trainer import create_train_state, make_optimizer
+    from rt1_tpu.trainer.checkpoints import (
+        CheckpointConfig,
+        CheckpointManager,
+    )
+
+    model = build_model(config.model)
+    rng = jax.random.PRNGKey(0)
+    t = config.model.time_sequence_length
+    h, w = config.data.height, config.data.width
+    obs = {
+        "image": np.zeros((1, t, h, w, 3), np.float32),
+        "natural_language_embedding": np.zeros((1, t, 512), np.float32),
+    }
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 1), (1, t)
+    )
+    state = create_train_state(model, rng, (obs, actions), make_optimizer())
+    ckpt = CheckpointManager(
+        CheckpointConfig(
+            directory=os.path.join(os.path.abspath(workdir), "checkpoints")
+        )
+    )
+    # restore() raises FileNotFoundError on an empty workdir — evaluating
+    # randomly initialized weights silently would be worse than failing.
+    state = ckpt.restore(state)
+    step = ckpt.latest_step()
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    return RT1EvalPolicy(model, variables), step
+
+
+def main(argv):
+    del argv
+    from absl import flags
+
+    from rt1_tpu.envs import blocks
+    from rt1_tpu.eval.evaluate import evaluate_policy
+
+    FLAGS = flags.FLAGS
+    config = FLAGS.config
+    policy, step = load_policy_from_workdir(config, FLAGS.workdir)
+    results = evaluate_policy(
+        policy,
+        workdir=FLAGS.workdir,
+        reward_names=tuple(FLAGS.rewards),
+        num_evals_per_reward=FLAGS.episodes,
+        max_episode_steps=FLAGS.max_steps,
+        block_mode=blocks.BlockMode(FLAGS.block_mode),
+        seed=FLAGS.seed,
+        embedder=FLAGS.embedder,
+        write_videos=FLAGS.videos,
+        env_kwargs=dict(
+            target_height=config.data.height,
+            target_width=config.data.width,
+            random_crop_factor=config.data.crop_factor,
+            sequence_length=config.model.time_sequence_length,
+        ),
+    )
+    results["checkpoint_step"] = step
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    from absl import app, flags
+    from ml_collections import config_flags
+
+    config_flags.DEFINE_config_file("config", None, "Model/data config.")
+    flags.DEFINE_string("workdir", "/tmp/rt1_tpu", "Checkpoint directory.")
+    flags.DEFINE_multi_string("rewards", ["block2block"], "Reward families.")
+    flags.DEFINE_integer("episodes", 10, "Episodes per reward.")
+    flags.DEFINE_integer("max_steps", 80, "Max steps per episode.")
+    flags.DEFINE_string("block_mode", "BLOCK_8", "Block variant.")
+    flags.DEFINE_integer("seed", 0, "Env seed.")
+    flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    flags.DEFINE_bool("videos", False, "Write episode videos.")
+    flags.mark_flags_as_required(["config"])
+    app.run(main)

@@ -1,0 +1,30 @@
+"""Tiny image tokenizer: a drop-in EfficientNet-B3 replacement.
+
+Used by smoke configs (`rt1_tpu/train/configs/tiny.py`) and tests to drive
+the full RT-1 policy/trainer/eval stack in seconds on one CPU core. A conv
+stem pools the frame and projects (with optional language context) straight
+to `num_tokens` embedding tokens.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyImageTokenizer(nn.Module):
+    num_tokens: int = 2
+    emb: int = 16
+
+    @nn.compact
+    def __call__(self, image, context=None, train=False):
+        b, t, h, w, c = image.shape
+        x = image.reshape(b * t, h, w, c)
+        x = nn.Conv(8, (3, 3), strides=(2, 2), name="conv")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))  # (b*t, 8)
+        if context is not None:
+            ctx = context.reshape(b * t, -1)
+            x = jnp.concatenate(
+                [x, nn.Dense(8, name="ctx_proj")(ctx)], axis=-1
+            )
+        tokens = nn.Dense(self.num_tokens * self.emb, name="tok")(x)
+        return tokens.reshape(b, t, self.num_tokens, self.emb)

@@ -1,0 +1,12 @@
+"""Training entry point: config system + full train loop.
+
+Replaces (SURVEY.md §2.2/§3.4):
+* Stack A `distribute_train.py` (argparse CLI, Lightning Trainer.fit), and
+* Stack B `train/main.py` + `train/train.py` (absl + ml_collections config
+  files, pmap loop) — whose config-file pattern we adopt, as SURVEY §5
+  recommends.
+"""
+
+from rt1_tpu.train.train import train_and_evaluate
+
+__all__ = ["train_and_evaluate"]

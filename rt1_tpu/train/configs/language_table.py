@@ -1,0 +1,64 @@
+"""Flagship config: RT-1 on Language-Table blocktoblock_sim.
+
+Hyperparameters mirror the reference's implied throughput baseline
+(`distribute_train.py:269-295` + SURVEY.md §2.1): batch 8/chip, seq_len 6,
+256x456 images, lr 5e-4 with MultiStepLR [50, 75, 90] gamma 0.1, 100 epochs
+over 7800 train episodes, vocab 256, 8 layers, TokenLearner with 8 tokens.
+"""
+
+import ml_collections
+
+
+def get_config():
+    config = ml_collections.ConfigDict()
+
+    # Model (SURVEY.md §2.1 instantiation).
+    config.model = ml_collections.ConfigDict()
+    config.model.vocab_size = 256
+    config.model.token_embedding_size = 512
+    config.model.num_layers = 8
+    config.model.layer_size = 128
+    config.model.num_heads = 8
+    config.model.feed_forward_size = 512
+    config.model.dropout_rate = 0.1
+    config.model.time_sequence_length = 6
+    config.model.use_token_learner = True
+    config.model.num_image_tokens = 8
+    config.model.image_tokenizer = "efficientnet_b3"
+    config.model.dtype = "bfloat16"
+
+    # Data.
+    config.data = ml_collections.ConfigDict()
+    config.data.data_dir = ""  # empty -> synthetic random batches (smoke)
+    config.data.height = 256
+    config.data.width = 456
+    config.data.crop_factor = 0.95
+    config.data.loader = "tf"  # "tf" | "numpy"
+    config.data.shuffle_buffer = 2048
+
+    # Training schedule (reference: 100 epochs x 975 steps at batch 8).
+    config.per_host_batch_size = 8
+    config.num_steps = 97_500
+    config.steps_per_epoch = 975
+    config.learning_rate = 5e-4
+    config.lr_milestones = (50, 75, 90)  # epochs
+    config.lr_gamma = 0.1
+    config.grad_clip_norm = 0.0  # 0 disables (reference has none)
+    config.accum_steps = 1
+    config.seed = 42
+
+    # Mesh (per-process view; -1 data = all remaining local devices).
+    config.mesh = ml_collections.ConfigDict()
+    config.mesh.data = -1
+    config.mesh.model = 1
+    config.mesh.seq = 1
+
+    # Checkpoint / logging cadence.
+    config.checkpoint_every_steps = 975
+    config.keep_period = 9750
+    config.max_to_keep = 0  # 0 -> keep all (reference save_top_k=-1)
+    config.log_every_steps = 50
+    config.eval_every_steps = 975
+    config.eval_batches = 6
+
+    return config

@@ -1,0 +1,30 @@
+"""CPU smoke config: tiny model + synthetic data, seconds to run."""
+
+from rt1_tpu.train.configs import language_table
+
+
+def get_config():
+    config = language_table.get_config()
+    config.model.token_embedding_size = 16
+    config.model.num_layers = 2
+    config.model.layer_size = 8
+    config.model.num_heads = 2
+    config.model.feed_forward_size = 16
+    config.model.vocab_size = 32
+    config.model.time_sequence_length = 3
+    config.model.num_image_tokens = 2
+    config.model.image_tokenizer = "tiny"
+    config.model.dtype = "float32"
+
+    config.data.height = 32
+    config.data.width = 56
+    # Divisible by the data axis on both 1-device and 8-device (virtual CPU
+    # mesh) runs.
+    config.per_host_batch_size = 8
+    config.num_steps = 4
+    config.steps_per_epoch = 2
+    config.checkpoint_every_steps = 2
+    config.log_every_steps = 1
+    config.eval_every_steps = 2
+    config.eval_batches = 1
+    return config

@@ -1,0 +1,259 @@
+"""The full SPMD training loop + absl CLI.
+
+Replaces `distribute_train.py:192-247` (Lightning Trainer.fit over DDP) and
+`language_table/train/train.py:60-218` (pmap loop) with one mesh-wide jitted
+step driven by a host loop: restore-or-initialize, per-step trace annotation,
+periodic metrics/checkpoint/eval, throughput accounting.
+
+Run:
+  python -m rt1_tpu.train.train --config rt1_tpu/train/configs/tiny.py \
+      --workdir /tmp/rt1
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rt1_tpu.parallel import MeshConfig, make_mesh
+from rt1_tpu.specs import language_table_action_space, sample_space
+from rt1_tpu.trainer import (
+    create_train_state,
+    make_optimizer,
+    make_train_step_fns,
+)
+from rt1_tpu.trainer.checkpoints import CheckpointConfig, CheckpointManager
+from rt1_tpu.trainer.metrics import (
+    ThroughputMeter,
+    create_writer,
+    log_parameter_overview,
+    scalars_from_metrics,
+    step_trace,
+    write_hparams,
+)
+
+
+def build_model(model_config):
+    from rt1_tpu.models.rt1 import RT1Policy
+
+    tokenizer_def = None
+    if model_config.image_tokenizer == "tiny":
+        from rt1_tpu.models.tiny_tokenizer import TinyImageTokenizer
+
+        tokenizer_def = TinyImageTokenizer(
+            num_tokens=model_config.num_image_tokens,
+            emb=model_config.token_embedding_size,
+        )
+    return RT1Policy(
+        action_space=language_table_action_space(),
+        vocab_size=model_config.vocab_size,
+        token_embedding_size=model_config.token_embedding_size,
+        num_layers=model_config.num_layers,
+        layer_size=model_config.layer_size,
+        num_heads=model_config.num_heads,
+        feed_forward_size=model_config.feed_forward_size,
+        dropout_rate=model_config.dropout_rate,
+        time_sequence_length=model_config.time_sequence_length,
+        use_token_learner=model_config.use_token_learner,
+        num_image_tokens=model_config.num_image_tokens,
+        image_tokenizer_def=tokenizer_def,
+        dtype=jnp.bfloat16
+        if model_config.dtype == "bfloat16"
+        else jnp.float32,
+    )
+
+
+def synthetic_batches(config, seed=0) -> Iterator:
+    """Random fixed batches when no dataset is configured (smoke/bench)."""
+    rng = np.random.default_rng(seed)
+    b = config.per_host_batch_size
+    t = config.model.time_sequence_length
+    h, w = config.data.height, config.data.width
+    while True:
+        obs = {
+            "image": rng.random((b, t, h, w, 3), dtype=np.float32),
+            "natural_language_embedding": rng.standard_normal(
+                (b, t, 512), dtype=np.float32
+            ),
+        }
+        actions = {
+            "terminate_episode": rng.integers(
+                0, 2, (b, t), dtype=np.int32
+            ),
+            "action": rng.uniform(-0.1, 0.1, (b, t, 2)).astype(np.float32),
+        }
+        yield {"observations": obs, "actions": actions}
+
+
+def dataset_batches(config, split="train") -> Iterator:
+    """Real data: windowed episode dataset, per-host sharded."""
+    import glob
+
+    from rt1_tpu.data.pipeline import WindowedEpisodeDataset
+
+    paths = sorted(
+        glob.glob(os.path.join(config.data.data_dir, split, "episode_*.np*"))
+    )
+    if not paths:
+        raise FileNotFoundError(
+            f"No episodes under {config.data.data_dir}/{split}"
+        )
+    ds = WindowedEpisodeDataset(
+        paths,
+        window=config.model.time_sequence_length,
+        crop_factor=config.data.crop_factor,
+        height=config.data.height,
+        width=config.data.width,
+    )
+    if config.data.loader == "tf":
+        tfds = ds.as_tf_dataset(
+            batch_size=config.per_host_batch_size,
+            seed=config.seed,
+            shuffle_buffer=config.data.shuffle_buffer,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+        )
+        return iter(tfds.as_numpy_iterator())
+    return ds.numpy_batches(
+        batch_size=config.per_host_batch_size,
+        seed=config.seed,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
+def train_and_evaluate(config, workdir: str):
+    """Run the training loop; returns the final TrainState."""
+    writer = create_writer(workdir)
+    write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
+
+    model = build_model(config.model)
+    mesh = make_mesh(
+        MeshConfig(
+            data=config.mesh.data,
+            model=config.mesh.model,
+            seq=config.mesh.seq,
+        )
+    )
+    data_size = mesh.shape["data"]
+    if config.per_host_batch_size % data_size != 0:
+        raise ValueError(
+            f"per_host_batch_size={config.per_host_batch_size} must be "
+            f"divisible by the mesh data axis ({data_size} devices)"
+        )
+
+    if config.data.data_dir:
+        train_iter = dataset_batches(config, "train")
+    else:
+        train_iter = synthetic_batches(config, config.seed)
+
+    first = next(train_iter)
+    example = (first["observations"], first["actions"])
+
+    tx = make_optimizer(
+        learning_rate=config.learning_rate,
+        milestones=config.lr_milestones,
+        gamma=config.lr_gamma,
+        steps_per_epoch=config.steps_per_epoch,
+        grad_clip_norm=config.grad_clip_norm or None,
+    )
+    rng = jax.random.PRNGKey(config.seed)
+    state = create_train_state(model, rng, example, tx)
+    if jax.process_index() == 0:
+        log_parameter_overview(
+            state.params, os.path.join(workdir, "parameters.txt")
+        )
+
+    ckpt = CheckpointManager(
+        CheckpointConfig(
+            directory=os.path.join(os.path.abspath(workdir), "checkpoints"),
+            max_to_keep=config.max_to_keep or None,
+            save_interval_steps=config.checkpoint_every_steps,
+            keep_period=config.keep_period,
+        )
+    )
+    state, initial_step = ckpt.restore_or_initialize(state)
+
+    fns = make_train_step_fns(
+        model, mesh, state, accum_steps=config.accum_steps
+    )
+    state = fns.shard_state(state)
+
+    eval_iter = None
+    if config.eval_every_steps:
+        if config.data.data_dir:
+            try:
+                eval_iter = dataset_batches(config, "val")
+            except FileNotFoundError:
+                eval_iter = None
+        else:
+            eval_iter = synthetic_batches(config, config.seed + 1)
+
+    meter = ThroughputMeter(
+        config.per_host_batch_size * jax.process_count()
+    )
+    batch = (first["observations"], first["actions"])
+    for step in range(initial_step, config.num_steps):
+        with step_trace("train", step):
+            sharded = fns.shard_batch(batch)
+            state, metrics = fns.train_step(
+                state, sharded, jax.random.fold_in(rng, step)
+            )
+        # Overlap: fetch next host batch while the device step runs.
+        nxt = next(train_iter)
+        batch = (nxt["observations"], nxt["actions"])
+
+        if (step + 1) % config.log_every_steps == 0:
+            scalars = scalars_from_metrics(metrics)
+            scalars.update(meter.update(step + 1))
+            writer.write_scalars(step + 1, scalars)
+
+        if (
+            eval_iter is not None
+            and (step + 1) % config.eval_every_steps == 0
+        ):
+            losses = []
+            for _ in range(config.eval_batches):
+                ev = next(eval_iter)
+                ev_metrics = fns.eval_step(
+                    state,
+                    fns.shard_batch((ev["observations"], ev["actions"])),
+                )
+                losses.append(scalars_from_metrics(ev_metrics)["loss"])
+            writer.write_scalars(
+                step + 1, {"eval_loss": float(np.mean(losses))}
+            )
+
+        last = step + 1 == config.num_steps
+        if last or (step + 1) % config.checkpoint_every_steps == 0:
+            # device_get only on save steps: the full-state D2H copy would
+            # otherwise sync the host every step and kill the prefetch overlap.
+            ckpt.save(step + 1, jax.device_get(state), force=last)
+
+    ckpt.wait_until_finished()
+    writer.flush()
+    return state
+
+
+def main(argv):
+    del argv
+    from absl import flags
+    from ml_collections import config_flags
+
+    FLAGS = flags.FLAGS
+    train_and_evaluate(FLAGS.config, FLAGS.workdir)
+
+
+if __name__ == "__main__":
+    from absl import app, flags
+    from ml_collections import config_flags
+
+    config_flags.DEFINE_config_file("config", None, "Config file.", lock_config=True)
+    flags.DEFINE_string("workdir", "/tmp/rt1_tpu", "Work/output directory.")
+    flags.mark_flags_as_required(["config"])
+    app.run(main)

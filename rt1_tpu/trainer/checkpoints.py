@@ -1,0 +1,83 @@
+"""Orbax checkpointing: save / restore-or-initialize / best-keep policy.
+
+Replaces (SURVEY.md §5 checkpoint/resume):
+* Stack A Lightning `ModelCheckpoint(save_top_k=-1, save_last=True,
+  every_n_epochs)` (`distribute_train.py:214-220`),
+* Stack B `clu.checkpoint.MultihostCheckpoint` + flax `save_checkpoint`
+  with `keep_every_n_steps` (`language_table/train/train.py:122-138,201-217`).
+
+Orbax is multihost-aware out of the box (each host writes its shards of a
+sharded TrainState; restore lays arrays back out on the mesh), which is the
+TPU-native replacement for clu's multihost rendezvous.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    directory: str
+    max_to_keep: Optional[int] = None  # None = keep everything (save_top_k=-1)
+    save_interval_steps: int = 1000
+    keep_period: Optional[int] = None  # also keep every Nth (keep_every_n_steps)
+
+
+class CheckpointManager:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, config: CheckpointConfig):
+        self._config = config
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=config.max_to_keep,
+            save_interval_steps=config.save_interval_steps,
+            keep_period=config.keep_period,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(
+            config.directory,
+            options=options,
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        saved = self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        return bool(saved)
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of `state_like`."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"No checkpoint found in {self._config.directory}"
+            )
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_like)
+        )
+
+    def restore_or_initialize(self, state_like: Any):
+        """(state, step): restored latest, or the passed-in init at step 0.
+
+        Mirrors `clu.checkpoint.restore_or_initialize` semantics
+        (`language_table/train/train.py:125-127`): training resumes from
+        `step + 1` after preemption.
+        """
+        latest = self._mgr.latest_step()
+        if latest is None:
+            return state_like, 0
+        return self.restore(state_like, latest), int(latest)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

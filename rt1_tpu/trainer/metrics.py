@@ -1,0 +1,97 @@
+"""Metrics, logging, and profiling hooks.
+
+Replaces (SURVEY.md §5 metrics/observability + tracing):
+* Stack A `self.log(..., sync_dist=True)` + CSV/TensorBoard loggers +
+  LearningRateMonitor (`distribute_train.py:69,221-228`),
+* Stack B `clu.metric_writers.create_default_writer` + hparams +
+  `parameter_overview` + `periodic_actions.ReportProgress` +
+  `jax.profiler.StepTraceAnnotation` (`language_table/train/train.py:
+  119-121,155-169,182`).
+
+Cross-device metric reduction needs no sync_dist plumbing: metric values come
+out of the jitted step already reduced over the mesh (jnp.mean over the
+global batch → XLA collective), so hosts just write scalars.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def create_writer(workdir: str, *, just_logging: bool = False):
+    """clu metric writer: TensorBoard + logging on host 0, no-op elsewhere."""
+    from clu import metric_writers
+
+    return metric_writers.create_default_writer(
+        workdir,
+        just_logging=just_logging or jax.process_index() > 0,
+    )
+
+
+def write_hparams(writer, config: Dict[str, Any]):
+    from clu import metric_writers
+
+    hparams = {
+        k: v
+        for k, v in config.items()
+        if isinstance(v, (int, float, str, bool))
+    }
+    writer.write_hparams(hparams)
+
+
+def log_parameter_overview(params, path: Optional[str] = None):
+    """Dump a per-parameter shape/size table (Stack B writes parameters.txt)."""
+    from clu import parameter_overview
+
+    overview = parameter_overview.get_parameter_overview(params)
+    if path is not None and jax.process_index() == 0:
+        with open(path, "w") as f:
+            f.write(overview)
+    return overview
+
+
+@contextlib.contextmanager
+def step_trace(name: str, step_num: int):
+    """`jax.profiler.StepTraceAnnotation` wrapper: marks steps in xplane."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step_num):
+        yield
+
+
+class ThroughputMeter:
+    """steps/sec + examples/sec over a sliding window of host time."""
+
+    def __init__(self, batch_size: int):
+        self._batch_size = batch_size
+        self._t0 = None
+        self._step0 = None
+
+    def update(self, step: int) -> Dict[str, float]:
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0, self._step0 = now, step
+            return {}
+        dt = now - self._t0
+        dsteps = step - self._step0
+        self._t0, self._step0 = now, step
+        if dt <= 0 or dsteps <= 0:
+            return {}
+        n_chips = max(jax.device_count(), 1)
+        return {
+            "steps_per_sec": dsteps / dt,
+            "steps_per_sec_per_chip": dsteps / dt / n_chips,
+            "examples_per_sec": dsteps * self._batch_size / dt,
+        }
+
+
+def scalars_from_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
+    """Pull device metrics to host floats (one transfer per scalar)."""
+    out = {}
+    for k, v in metrics.items():
+        arr = np.asarray(jax.device_get(v))
+        out[k] = float(arr.mean()) if arr.ndim else float(arr)
+    return out

@@ -159,7 +159,14 @@ def test_state_restore_preserves_task_with_task_updating_reward():
     env.reset()
     saved = env.get_board_state()
     saved_instruction = env.instruction_str
-    env.reset()  # new episode, new task
-    assert env.instruction_str != saved_instruction or True  # may collide
+    # New episode with a different task (re-seed until it differs).
+    reseed = 100
+    while env.instruction_str == saved_instruction:
+        env.seed(reseed)
+        env.reset()
+        reseed += 1
     env.set_board_state(saved)
+    assert env.instruction_str == saved_instruction
+    # The restored task must survive stepping (reward internals restored too).
+    env.step(np.array([0.0, 0.0]))
     assert env.instruction_str == saved_instruction

@@ -23,24 +23,7 @@ VOCAB = 32
 H = W = 16
 
 
-class TinyImageTokenizer(nn.Module):
-    """Drop-in B3 replacement for tests: conv stem → TokenLearner-free projection."""
-
-    num_tokens: int = I_TOK
-    emb: int = EMB
-
-    @nn.compact
-    def __call__(self, image, context=None, train=False):
-        b, t, h, w, c = image.shape
-        x = image.reshape(b * t, h, w, c)
-        x = nn.Conv(8, (3, 3), strides=(2, 2), name="conv")(x)
-        x = nn.relu(x)
-        x = jnp.mean(x, axis=(1, 2))  # (b*t, 8)
-        if context is not None:
-            ctx = context.reshape(b * t, -1)
-            x = jnp.concatenate([x, nn.Dense(8, name="ctx_proj")(ctx)], axis=-1)
-        tokens = nn.Dense(self.num_tokens * self.emb, name="tok")(x)
-        return tokens.reshape(b, t, self.num_tokens, self.emb)
+from rt1_tpu.models.tiny_tokenizer import TinyImageTokenizer  # noqa: E402
 
 
 def tiny_policy(**kw):
@@ -55,7 +38,7 @@ def tiny_policy(**kw):
         dropout_rate=0.0,
         time_sequence_length=T,
         num_image_tokens=I_TOK,
-        image_tokenizer_def=TinyImageTokenizer(),
+        image_tokenizer_def=TinyImageTokenizer(num_tokens=I_TOK, emb=EMB),
     )
     cfg.update(kw)
     return RT1Policy(**cfg)
