@@ -1,0 +1,33 @@
+"""LAVA model family (Stack B of the reference).
+
+Parity source: reference `language_table/train/networks/` — the vendored
+Google JAX BC stack's architectures: `SequenceLAVMSE` (language-conditioned
+cross-attention over a visual feature pyramid + temporal transformer) and
+`PixelLangMSE` (conv-maxpool with multiplicative language fusion), both
+regressing continuous actions with MSE (`bc.py:206-234`).
+"""
+
+from rt1_tpu.models.lava.blocks import (
+    Add1DPositionEmbedding,
+    DenseResnet,
+    PrenormEncoderLayer,
+    PrenormPixelLangEncoder,
+    TemporalTransformer,
+    positional_encoding_2d,
+)
+from rt1_tpu.models.lava.lava import SequenceLAVAEncoder, SequenceLAVMSE
+from rt1_tpu.models.lava.pixel import PixelLangMSE
+from rt1_tpu.models.lava.resnet import MultiscaleResNet
+
+__all__ = [
+    "Add1DPositionEmbedding",
+    "DenseResnet",
+    "PrenormEncoderLayer",
+    "PrenormPixelLangEncoder",
+    "TemporalTransformer",
+    "positional_encoding_2d",
+    "SequenceLAVAEncoder",
+    "SequenceLAVMSE",
+    "PixelLangMSE",
+    "MultiscaleResNet",
+]

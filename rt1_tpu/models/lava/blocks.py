@@ -1,0 +1,187 @@
+"""Shared LAVA building blocks.
+
+Parity sources: reference `networks/dense_resnet.py` (residual MLP),
+`networks/lava.py:101-218` (sinusoidal 1-D/2-D position encodings),
+`:268-371` (prenorm cross/self attention layers + temporal transformer).
+All dense layers use the reference's normal(0.05) init for both kernel and
+bias.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_INIT = jax.nn.initializers.normal(stddev=0.05)
+
+
+def _dense(features, name=None):
+    return nn.Dense(features, kernel_init=_INIT, bias_init=_INIT, name=name)
+
+
+class ResnetDenseBlock(nn.Module):
+    """relu -> Dense(w/4) -> relu -> Dense(w/4) -> relu -> Dense(w) + skip."""
+
+    width: int
+
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        y = nn.relu(x)
+        y = _dense(self.width // 4)(y)
+        y = nn.relu(y)
+        y = _dense(self.width // 4)(y)
+        y = nn.relu(y)
+        y = _dense(self.width)(y)
+        return x + y
+
+
+class DenseResnet(nn.Module):
+    """Dense projection + N residual MLP blocks (+ optional value head)."""
+
+    width: int
+    num_blocks: int
+    value_net: bool = False
+
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        x = _dense(self.width)(x)
+        for _ in range(self.num_blocks):
+            x = ResnetDenseBlock(self.width)(x, train=train)
+        if self.value_net:
+            x = _dense(1)(x)
+        return x
+
+
+def sinusoidal_position_encoding(max_len, d_feature, max_timescale=1.0e4):
+    """(1, max_len, d_feature) fixed sin/cos table."""
+    pe = np.zeros((max_len, d_feature), dtype=np.float32)
+    position = np.arange(0, max_len)[:, None]
+    div_term = np.exp(
+        np.arange(0, d_feature, 2) * -(np.log(max_timescale) / d_feature)
+    )
+    pe[:, 0::2] = np.sin(position * div_term)
+    pe[:, 1::2] = np.cos(position * div_term)
+    return jnp.asarray(pe[None])
+
+
+class Add1DPositionEmbedding(nn.Module):
+    """Adds the fixed sinusoidal table to (b, t, d) inputs."""
+
+    max_len: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, inputs):
+        assert inputs.ndim == 3, f"expected (b, t, d), got {inputs.shape}"
+        length = inputs.shape[1]
+        max_len = self.max_len or length
+        pe = sinusoidal_position_encoding(max_len, inputs.shape[-1])
+        return inputs + pe[:, :length, :]
+
+
+def positional_encoding_2d(d_model, height, width, flatten=True):
+    """(1, h*w, d) fixed 2-D sin/cos table: half the channels encode width
+    position, half encode height (reference `positional_encoding2d:189-218`)."""
+    if d_model % 4 != 0:
+        raise ValueError(f"2d sincos needs d_model % 4 == 0, got {d_model}")
+    pe = np.zeros([d_model, height, width], dtype=np.float32)
+    half = d_model // 2
+    div_term = np.exp(np.arange(0.0, half, 2) * -(np.log(10000.0) / half))
+    pos_w = np.arange(0.0, width)[:, None]
+    pos_h = np.arange(0.0, height)[:, None]
+    pe[0:half:2] = np.tile(
+        np.transpose(np.sin(pos_w * div_term))[:, None, :], [1, height, 1]
+    )
+    pe[1:half:2] = np.tile(
+        np.transpose(np.cos(pos_w * div_term))[:, None, :], [1, height, 1]
+    )
+    pe[half::2] = np.tile(
+        np.transpose(np.sin(pos_h * div_term))[:, :, None], [1, 1, width]
+    )
+    pe[half + 1::2] = np.tile(
+        np.transpose(np.cos(pos_h * div_term))[:, :, None], [1, 1, width]
+    )
+    if flatten:
+        pe = np.reshape(pe, [height * width, d_model])
+    else:
+        pe = np.reshape(pe, [height, width, d_model])
+    return jnp.asarray(pe[None])
+
+
+class PrenormPixelLangEncoder(nn.Module):
+    """Cross-attention: language queries attend over the visual sentence."""
+
+    num_heads: int
+    dropout_rate: float
+    mha_dropout_rate: float
+    dff: int
+
+    @nn.compact
+    def __call__(self, pixel_x, lang_x, *, train=False):
+        residual_lang = lang_x
+        pixel_x = nn.LayerNorm()(pixel_x)
+        lang_x = nn.LayerNorm()(lang_x)
+        attended = nn.MultiHeadDotProductAttention(
+            self.num_heads, dropout_rate=self.mha_dropout_rate
+        )(lang_x, pixel_x, deterministic=not train)
+        attended = nn.Dropout(self.dropout_rate)(
+            attended, deterministic=not train
+        )
+        x = residual_lang + attended  # residual only on the language path
+        y = nn.LayerNorm()(x)
+        y = _dense(self.dff)(y)
+        y = nn.relu(y)
+        y = _dense(self.dff)(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=not train)
+        return x + y
+
+
+class PrenormEncoderLayer(nn.Module):
+    """Standard prenorm self-attention block."""
+
+    num_heads: int
+    dropout_rate: float
+    mha_dropout_rate: float
+    dff: int
+
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        y = nn.LayerNorm()(x)
+        y = nn.MultiHeadDotProductAttention(
+            self.num_heads, dropout_rate=self.mha_dropout_rate
+        )(y, y, deterministic=not train)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=not train)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = _dense(self.dff)(y)
+        y = nn.relu(y)
+        y = _dense(self.dff)(y)
+        y = nn.Dropout(self.dropout_rate)(y, deterministic=not train)
+        return x + y
+
+
+class TemporalTransformer(nn.Module):
+    """Self-attention over frames, mean-pooled (reference `:336-371`)."""
+
+    num_layers: int
+    d_model: int
+    num_heads: int
+    dff: int
+    sequence_length: int
+
+    @nn.compact
+    def __call__(self, x, *, train=False):
+        x = _dense(self.d_model)(x)
+        x = x * jnp.sqrt(self.d_model)
+        x = Add1DPositionEmbedding(max_len=self.sequence_length)(x)
+        x = nn.Dropout(0.1)(x, deterministic=not train)
+        for _ in range(self.num_layers):
+            x = PrenormEncoderLayer(
+                num_heads=self.num_heads,
+                dropout_rate=0.1,
+                mha_dropout_rate=0.0,
+                dff=self.dff,
+            )(x, train=train)
+        x = jnp.mean(x, axis=1)
+        return nn.LayerNorm()(x)

@@ -1,0 +1,82 @@
+"""PixelLang baseline: channel-stacked frames + multiplicative language fusion.
+
+Parity source: reference `language_table/train/networks/pixel.py:25-111`.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rt1_tpu.models.lava.blocks import DenseResnet
+
+_INIT = jax.nn.initializers.normal(stddev=0.05)
+
+
+class LanguageFusion(nn.Module):
+    """Project language to the image channel dim and multiply per-pixel."""
+
+    @nn.compact
+    def __call__(self, lang, image):
+        lang = nn.Dense(
+            image.shape[-1], kernel_init=_INIT, bias_init=_INIT
+        )(lang)
+        h, w = image.shape[1], image.shape[2]
+        lang = jnp.tile(lang[:, None, None, :], [1, h, w, 1])
+        return image * lang
+
+
+class ConvMaxpoolLanguageEncoder(nn.Module):
+    """Conv stack with multiplicative language fusion from layer 2 on."""
+
+    @nn.compact
+    def __call__(self, rgb, lang_embedding, *, train=False):
+        x = rgb
+        fuse_from = 2
+        conv_channels = (32, 64, 128, 256)
+        for idx, ch in enumerate(conv_channels):
+            x = nn.Conv(ch, (3, 3), padding="SAME")(x)
+            if fuse_from <= idx + 1:
+                x = LanguageFusion()(lang_embedding, x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="VALID")
+        x = jnp.mean(x, axis=(1, 2))
+        # Final multiplicative gate on the pooled features.
+        lang_info = nn.Dense(
+            conv_channels[-1], kernel_init=_INIT, bias_init=_INIT
+        )(lang_embedding)
+        x = x * lang_info
+        x = nn.relu(x)
+        return nn.LayerNorm()(x)
+
+
+class PixelLangMSE(nn.Module):
+    """Channel-stack frames, fuse language, regress actions with MSE."""
+
+    action_size: int
+    dense_resnet_width: int
+    dense_resnet_num_blocks: int
+    lang_key: str = "natural_language_embedding"
+
+    def setup(self):
+        self.encoder = ConvMaxpoolLanguageEncoder()
+        self.dense_resnet = DenseResnet(
+            width=self.dense_resnet_width,
+            num_blocks=self.dense_resnet_num_blocks,
+            value_net=False,
+        )
+        self.action_projection = nn.Dense(
+            self.action_size, kernel_init=_INIT, bias_init=_INIT
+        )
+
+    def __call__(self, obs, *, train=False):
+        rgb = obs["rgb"]
+        b, n, h, w, c = rgb.shape
+        # Stack history channelwise. Deviation (documented): the reference
+        # does a raw reshape (b,n,w,h,c)->(b,w,h,c*n) (pixel.py:100-103),
+        # which interleaves frames across spatial rows; we transpose first so
+        # each channel block is one coherent frame.
+        rgb = jnp.transpose(rgb, (0, 2, 3, 1, 4)).reshape(b, h, w, c * n)
+        lang = obs[self.lang_key][:, -1]
+        encoded = self.encoder(rgb, lang, train=train)
+        x = self.dense_resnet(encoded, train=train)
+        return self.action_projection(x)

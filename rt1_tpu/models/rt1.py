@@ -99,6 +99,11 @@ class RT1Policy(nn.Module):
     loss_scale: str = "reference"     # 'reference' (:314-319) or 'mean'
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
+    # "dense" (default) or "ring": ring attention shards the token sequence
+    # over the mesh's ``seq`` axis (sequence/context parallelism for
+    # long-horizon variants; requires `mesh` with a >1 seq axis).
+    attention_impl: str = "dense"
+    mesh: Optional[Any] = None
     # Optional custom image tokenizer module (must map (b,t,H,W,3), (b,t,D) →
     # (b,t,num_image_tokens,token_embedding_size)); used by tests to swap the
     # EfficientNet-B3 backbone for a tiny one.
@@ -144,6 +149,8 @@ class RT1Policy(nn.Module):
             max_seq_len=max(256, self.sequence_tokens),
             return_attention_scores=self.return_attention_scores,
             dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            mesh=self.mesh,
         )
         self._mask = rt1_attention_mask(
             self.time_sequence_length, self.tokens_per_image, self.tokens_per_action

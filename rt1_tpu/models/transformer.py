@@ -21,7 +21,7 @@ attention probabilities matches the reference's placement (`transformer.py:94-98
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -30,13 +30,21 @@ NEG_INF = -1e9
 
 
 class TFMultiHeadAttention(nn.Module):
-    """tf.keras-style MHA: qkv project d_model → heads·key_dim, out back to d_model."""
+    """tf.keras-style MHA: qkv project d_model → heads·key_dim, out back to d_model.
+
+    `attention_impl="ring"` + a mesh with a >1 ``seq`` axis computes the same
+    attention ring-parallel over sequence shards (rt1_tpu/parallel/
+    ring_attention.py) — exact, but attention probabilities are never
+    materialized, so prob-dropout is skipped and no scores are returned.
+    """
 
     num_heads: int
     key_dim: int
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "dense"     # "dense" | "ring"
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(
@@ -44,12 +52,34 @@ class TFMultiHeadAttention(nn.Module):
         x: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
         train: bool = False,
-    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         b, s, _ = x.shape
         h, k = self.num_heads, self.key_dim
         q = nn.Dense(h * k, dtype=self.dtype, name="query")(x).reshape(b, s, h, k)
         kk = nn.Dense(h * k, dtype=self.dtype, name="key")(x).reshape(b, s, h, k)
         v = nn.Dense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
+
+        use_ring = (
+            self.attention_impl == "ring"
+            and self.mesh is not None
+            and self.mesh.shape.get("seq", 1) > 1
+        )
+        if use_ring:
+            from rt1_tpu.parallel.ring_attention import ring_attention
+
+            if mask is not None and mask.ndim != 2:
+                raise ValueError("ring attention supports (s, s) masks only")
+            out = ring_attention(
+                q,
+                kk,
+                v,
+                self.mesh,
+                mask=mask,
+                scale=1.0 / float(k) ** 0.5,
+            )
+            out = out.reshape(b, s, h * k)
+            return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), None
+
         # (b, h, sq, sk) attention logits; fp32 softmax for stability under bf16.
         logits = jnp.einsum("bshd,bthd->bhst", q, kk, preferred_element_type=jnp.float32)
         logits = logits / jnp.sqrt(jnp.asarray(k, jnp.float32))
@@ -75,6 +105,8 @@ class TransformerLayer(nn.Module):
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "dense"
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -85,6 +117,8 @@ class TransformerLayer(nn.Module):
             d_model=self.d_model,
             dropout_rate=self.dropout_rate,
             dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            mesh=self.mesh,
             name="attn",
         )(y, mask=mask, train=train)
         x = x + attn_out
@@ -106,6 +140,8 @@ class CausalTransformer(nn.Module):
     max_seq_len: int = 256
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "dense"
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
@@ -114,6 +150,11 @@ class CausalTransformer(nn.Module):
         if s > self.max_seq_len:
             raise ValueError(
                 f"sequence length {s} exceeds max_seq_len={self.max_seq_len}"
+            )
+        if self.return_attention_scores and self.attention_impl == "ring":
+            raise ValueError(
+                "attention scores are not materialized under ring attention; "
+                "use attention_impl='dense' for score visualization"
             )
         x = nn.Dense(self.d_model, dtype=self.dtype, name="token_emb")(inputs)
         pos_emb = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype, name="position_emb")(
@@ -128,6 +169,8 @@ class CausalTransformer(nn.Module):
                 d_model=self.d_model,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
+                attention_impl=self.attention_impl,
+                mesh=self.mesh,
                 name=f"layer_{i}",
             )(x, mask=attention_mask, train=train)
             if self.return_attention_scores:

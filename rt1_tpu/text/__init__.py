@@ -1,0 +1,15 @@
+"""Text tokenization utilities."""
+
+from rt1_tpu.text.clip_bpe import (
+    CLIP_CONTEXT_LENGTH,
+    CLIP_VOCAB_SIZE,
+    ClipBPETokenizer,
+    bytes_to_unicode,
+)
+
+__all__ = [
+    "CLIP_CONTEXT_LENGTH",
+    "CLIP_VOCAB_SIZE",
+    "ClipBPETokenizer",
+    "bytes_to_unicode",
+]

@@ -1,0 +1,148 @@
+"""MSE behavioral-cloning loss + optimizer freezing for the LAVA stack.
+
+Parity source: reference `language_table/train/bc.py`:
+* `bc_loss` (`:206-234`): MSE between predicted and target actions,
+  normalized by action statistics when provided;
+* Adam(eps=1e-7) with per-path freezing via `optax.multi_transform`
+  (`:119-140`) — used to freeze the pretrained text/image towers;
+* pretrained-checkpoint key remapping (`:94-110`) generalized to a
+  prefix-rewrite over flat param paths.
+
+These compose with the shared SPMD machinery (`rt1_tpu/trainer/train.py`):
+pass `loss_fn=bc_mse_loss_fn(model)` style closures into jitted steps, or use
+the generic train step with an MSE-returning model wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def bc_mse_loss(
+    predicted: jnp.ndarray,
+    target: jnp.ndarray,
+    norm_mean: Optional[jnp.ndarray] = None,
+    norm_std: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean-squared BC loss, optionally in normalized action space."""
+    if norm_mean is not None and norm_std is not None:
+        target = (target - norm_mean) / (norm_std + 1e-8)
+    return jnp.mean(jnp.square(predicted - target))
+
+
+def make_bc_optimizer(
+    learning_rate: float = 1e-3,
+    eps: float = 1e-7,
+    frozen_prefixes: Sequence[str] = (),
+) -> optax.GradientTransformation:
+    """Adam with optional frozen parameter subtrees.
+
+    `frozen_prefixes` are '/'-joined path prefixes into the param tree, e.g.
+    ("encoder/TextEncoder_0",) — matching params get zero updates
+    (reference freezes `TextEncoder_0`,
+    `configs/language_table_sim_local.py:50-58`).
+    """
+    adam = optax.adam(learning_rate, eps=eps)
+    if not frozen_prefixes:
+        return adam
+
+    def label(params):
+        flat = flax.traverse_util.flatten_dict(params)
+        labels = {}
+        for path in flat:
+            joined = "/".join(str(p) for p in path)
+            frozen = any(
+                joined.startswith(prefix) for prefix in frozen_prefixes
+            )
+            labels[path] = "frozen" if frozen else "trainable"
+        return flax.traverse_util.unflatten_dict(labels)
+
+    return optax.multi_transform(
+        {"trainable": adam, "frozen": optax.set_to_zero()}, label
+    )
+
+
+def remap_pretrained_params(
+    params: Dict[str, Any],
+    pretrained: Dict[str, Any],
+    prefix_map: Dict[str, str],
+) -> Dict[str, Any]:
+    """Copy pretrained subtrees into params under new path prefixes.
+
+    `prefix_map`: {pretrained_prefix: target_prefix} over '/'-joined flat
+    paths (generalizes the reference's key rewriting, `bc.py:94-110`).
+    Returns a new param tree; paths not covered keep their initialized
+    values. Raises if a remapped source path is missing.
+    """
+    flat_params = flax.traverse_util.flatten_dict(params)
+    flat_pre = flax.traverse_util.flatten_dict(pretrained)
+    joined_pre = {
+        "/".join(str(p) for p in k): (k, v) for k, v in flat_pre.items()
+    }
+
+    out = dict(flat_params)
+    for src_prefix, dst_prefix in prefix_map.items():
+        hits = 0
+        for joined, (_, value) in joined_pre.items():
+            if not joined.startswith(src_prefix):
+                continue
+            dst_joined = dst_prefix + joined[len(src_prefix):]
+            dst_key = tuple(dst_joined.split("/"))
+            if dst_key not in out:
+                raise KeyError(
+                    f"Remap target {dst_joined!r} not present in params"
+                )
+            if out[dst_key].shape != value.shape:
+                raise ValueError(
+                    f"Shape mismatch at {dst_joined!r}: "
+                    f"{out[dst_key].shape} vs {value.shape}"
+                )
+            out[dst_key] = value
+            hits += 1
+        if hits == 0:
+            raise KeyError(
+                f"No pretrained params matched prefix {src_prefix!r}"
+            )
+    return flax.traverse_util.unflatten_dict(out)
+
+
+def make_bc_loss_fn(
+    model: Any,
+    batch_stats: Optional[Any] = None,
+) -> Callable:
+    """(params, batch, rng, train) -> (loss, metrics) for MSE-head models.
+
+    `batch` = (observations, actions) where actions is either the raw (b, d)
+    target array or a dict with an "action" entry (windowed pipeline format,
+    in which case the LAST frame's action is the target — the LAVA models
+    predict one action per window).
+
+    `batch_stats`: the model's BatchNorm stats collection, required when the
+    image tower uses BatchNorm (lava_image_encoder="resnet"). The tower is
+    frozen (always applied with use_running_average), so stats are read-only
+    and can be closed over.
+    """
+
+    def loss_fn(params, batch, rng, train=True):
+        obs, actions = batch
+        target = actions["action"] if isinstance(actions, dict) else actions
+        if target.ndim == 3:
+            target = target[:, -1]
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+        predicted = model.apply(
+            variables,
+            obs,
+            train=train,
+            rngs={"dropout": rng} if train else {},
+        )
+        loss = bc_mse_loss(predicted, target)
+        return loss, {"loss": loss}
+
+    return loss_fn

@@ -1,0 +1,91 @@
+"""CLIP BPE tokenizer algorithm tests (synthetic merge table).
+
+The real CLIP vocab gz isn't bundled; these verify the algorithm itself:
+byte-unicode reversibility, merge application in rank order, </w> terminal
+handling, CLIP vocab layout, SOT/EOT framing, and encode/decode round-trip.
+"""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.text import ClipBPETokenizer, bytes_to_unicode
+
+# A tiny merge table: builds "th", "the</w>", "he", etc.
+MERGES = [
+    ("t", "h"),
+    ("th", "e</w>"),
+    ("h", "e</w>"),
+    ("l", "l"),
+    ("b", "a"),
+    ("ll", "o</w>"),
+]
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return ClipBPETokenizer(MERGES)
+
+
+def test_bytes_to_unicode_reversible():
+    m = bytes_to_unicode()
+    assert len(m) == 256
+    assert len(set(m.values())) == 256
+
+
+def test_vocab_layout(tok):
+    # 256 bytes + 256 </w> variants + merges + SOT/EOT.
+    assert tok.vocab_size == 512 + len(MERGES) + 2
+    assert tok.sot_token == tok.vocab_size - 2
+    assert tok.eot_token == tok.vocab_size - 1
+
+
+def test_merges_applied_in_rank_order(tok):
+    ids = tok.encode("the")
+    # 'the' -> t h e</w> -> th e</w> -> the</w> (single merged token).
+    assert ids == [tok.encoder["the</w>"]]
+
+
+def test_unmerged_falls_back_to_pieces(tok):
+    ids = tok.encode("ba")
+    # 'ba' merge exists but 'a</w>' ending: b a</w> -> only ('b','a') rank
+    # applies to non-terminal pair; final pieces exist in vocab.
+    assert all(i in tok.decoder for i in ids)
+    assert tok.decode(ids) == "ba"
+
+
+def test_roundtrip_word_text(tok):
+    # Word-only text round-trips exactly; punctuation gains CLIP's
+    # token-boundary spaces (see test_contraction_split).
+    for text in ["hello there", "the the the", "a b c"]:
+        assert tok.decode(tok.encode(text)) == text.lower()
+    # Digits tokenize one-at-a-time ([\p{N}]), so decode space-separates.
+    assert tok.decode(tok.encode("123")) == "1 2 3"
+
+
+def test_tokenize_text_framing(tok):
+    arr = tok.tokenize_text(["the", "hello"])
+    assert arr.shape == (2, 77)
+    assert arr.dtype == np.int32
+    assert arr[0, 0] == tok.sot_token
+    row = arr[0]
+    eot_pos = int(np.argwhere(row == tok.eot_token)[0])
+    assert (row[eot_pos + 1 :] == 0).all()
+
+
+def test_tokenize_text_too_long_raises(tok):
+    with pytest.raises(ValueError, match="too long"):
+        tok.tokenize_text(["z " * 60], context_length=16)
+
+
+def test_whitespace_and_case_cleaning(tok):
+    a = tok.encode("  The   THE\n the ")
+    b = tok.encode("the the the")
+    assert a == b
+
+
+def test_contraction_split(tok):
+    # "'s" splits off as its own token; CLIP's decode reinserts a space at
+    # every token boundary (same as OpenAI SimpleTokenizer).
+    ids = tok.encode("it's")
+    assert tok.decode(ids) == "it 's"
+    assert tok.decode(tok.encode("push the block!")) == "push the block !"

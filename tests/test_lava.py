@@ -1,0 +1,156 @@
+"""LAVA model-family tests: shapes, both encoders, BC loss/freezing/remap."""
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rt1_tpu.models.lava import (
+    DenseResnet,
+    PixelLangMSE,
+    SequenceLAVMSE,
+    positional_encoding_2d,
+)
+from rt1_tpu.trainer.bc import (
+    bc_mse_loss,
+    make_bc_loss_fn,
+    make_bc_optimizer,
+    remap_pretrained_params,
+)
+
+B, T, H, W = 2, 4, 64, 64
+
+
+def _obs(rng, h=H, w=W):
+    return {
+        "rgb": jax.random.uniform(rng, (B, T, h, w, 3)),
+        "natural_language_embedding": jax.random.normal(
+            jax.random.fold_in(rng, 1), (B, T, 32)
+        ),
+    }
+
+
+def test_sequence_lav_mse_conv_maxpool():
+    model = SequenceLAVMSE(
+        action_size=2,
+        dense_resnet_width=64,
+        dense_resnet_num_blocks=2,
+        lava_d_model=32,
+        lava_sequence_length=T,
+        lava_pyramid_fuse_layers=(2, 3, 4),
+        lava_image_encoder="conv_maxpool",
+    )
+    rng = jax.random.PRNGKey(0)
+    obs = _obs(rng)
+    variables = model.init({"params": rng}, obs, train=False)
+    out = model.apply(variables, obs, train=False)
+    assert out.shape == (B, 2)
+    assert np.isfinite(np.asarray(out)).all()
+    # Dropout path works.
+    out_train = model.apply(
+        variables, obs, train=True, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    assert out_train.shape == (B, 2)
+
+
+def test_sequence_lav_mse_resnet_encoder():
+    model = SequenceLAVMSE(
+        action_size=2,
+        dense_resnet_width=32,
+        dense_resnet_num_blocks=1,
+        lava_d_model=32,
+        lava_sequence_length=2,
+        lava_pyramid_fuse_layers=(2, 3),
+        lava_image_encoder="resnet",
+    )
+    rng = jax.random.PRNGKey(0)
+    obs = {
+        "rgb": jax.random.uniform(rng, (1, 2, 64, 64, 3)),
+        "natural_language_embedding": jax.random.normal(
+            jax.random.fold_in(rng, 1), (1, 2, 32)
+        ),
+    }
+    variables = model.init({"params": rng}, obs, train=False)
+    out = model.apply(variables, obs, train=False)
+    assert out.shape == (1, 2)
+    # Frozen ResNet tower still creates batch_stats collections.
+    assert "batch_stats" in variables
+
+
+def test_pixel_lang_mse():
+    model = PixelLangMSE(
+        action_size=2, dense_resnet_width=64, dense_resnet_num_blocks=2
+    )
+    rng = jax.random.PRNGKey(0)
+    obs = _obs(rng)
+    variables = model.init({"params": rng}, obs, train=False)
+    out = model.apply(variables, obs, train=False)
+    assert out.shape == (B, 2)
+
+
+def test_positional_encoding_2d_shape_and_range():
+    pe = positional_encoding_2d(32, 5, 7)
+    assert pe.shape == (1, 35, 32)
+    assert float(jnp.max(jnp.abs(pe))) <= 1.0 + 1e-6
+
+
+def test_bc_mse_loss_normalization():
+    pred = jnp.zeros((4, 2))
+    target = jnp.ones((4, 2)) * 3.0
+    assert float(bc_mse_loss(pred, target)) == pytest.approx(9.0)
+    normed = bc_mse_loss(
+        pred, target, norm_mean=jnp.ones(2) * 3.0, norm_std=jnp.ones(2)
+    )
+    assert float(normed) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_bc_optimizer_freezing():
+    params = {
+        "encoder": {"tower": {"w": jnp.ones((3,))}},
+        "head": {"w": jnp.ones((3,))},
+    }
+    tx = make_bc_optimizer(1e-2, frozen_prefixes=("encoder/tower",))
+    opt_state = tx.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, opt_state, params)
+    new = optax.apply_updates(params, updates)
+    np.testing.assert_array_equal(
+        new["encoder"]["tower"]["w"], params["encoder"]["tower"]["w"]
+    )
+    assert not np.allclose(new["head"]["w"], params["head"]["w"])
+
+
+def test_remap_pretrained_params():
+    params = {
+        "encoder": {"text": {"w": jnp.zeros((2, 2))}},
+        "head": {"w": jnp.zeros((2,))},
+    }
+    pretrained = {"backbone": {"w": jnp.ones((2, 2))}}
+    out = remap_pretrained_params(
+        params, pretrained, {"backbone": "encoder/text"}
+    )
+    np.testing.assert_array_equal(out["encoder"]["text"]["w"], np.ones((2, 2)))
+    np.testing.assert_array_equal(out["head"]["w"], np.zeros((2,)))
+    with pytest.raises(KeyError):
+        remap_pretrained_params(params, pretrained, {"missing": "head"})
+
+
+def test_bc_loss_fn_end_to_end():
+    model = PixelLangMSE(
+        action_size=2, dense_resnet_width=32, dense_resnet_num_blocks=1
+    )
+    rng = jax.random.PRNGKey(0)
+    obs = _obs(rng, h=32, w=32)
+    actions = {"action": jax.random.uniform(rng, (B, T, 2))}
+    variables = model.init({"params": rng}, obs, train=False)
+    loss_fn = make_bc_loss_fn(model)
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        variables["params"], (obs, actions), rng, True
+    )
+    assert np.isfinite(float(loss))
+    assert metrics["loss"] == loss
+    assert any(
+        float(jnp.abs(g).sum()) > 0 for g in jax.tree.leaves(grads)
+    )
