@@ -1,0 +1,274 @@
+// Native episode reader: mmap-backed .npy / .npz parsing with a C ABI.
+//
+// Replaces the reference data path's per-sample `np.load` of whole episode
+// files (`load_np_dataset.py:79-83`, SURVEY.md §7 hard-part 7) at a lower
+// level: one mmap per episode, zero-copy array views for uncompressed
+// members, zlib inflate for deflated npz members. Exposed to Python via
+// ctypes (rt1_tpu/data/native.py); the pipeline falls back to numpy when
+// the shared library is unavailable.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 episode_reader.cc -lz \
+//          -o libepisode_reader.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <zlib.h>
+
+namespace {
+
+constexpr int kMaxDims = 8;
+
+struct Member {
+  std::string name;
+  std::string dtype;          // numpy descr, e.g. "<f4", "|u1"
+  int ndim = 0;
+  int64_t shape[kMaxDims] = {0};
+  const uint8_t* data = nullptr;  // zero-copy view into the mmap, or...
+  std::vector<uint8_t> owned;     // ...inflated buffer for deflated members
+  int64_t nbytes = 0;
+};
+
+struct Reader {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t map_size = 0;
+  std::vector<Member> members;
+  std::string error;
+};
+
+// ---------------------------------------------------------------- npy header
+
+bool parse_npy(const uint8_t* buf, size_t len, Member* m) {
+  if (len < 10 || memcmp(buf, "\x93NUMPY", 6) != 0) return false;
+  const uint8_t major = buf[6];
+  size_t header_len, header_off;
+  if (major == 1) {
+    header_len = buf[8] | (buf[9] << 8);
+    header_off = 10;
+  } else {
+    if (len < 12) return false;
+    header_len = buf[8] | (buf[9] << 8) | (buf[10] << 16)
+        | (static_cast<size_t>(buf[11]) << 24);
+    header_off = 12;
+  }
+  if (header_off + header_len > len) return false;
+  std::string header(reinterpret_cast<const char*>(buf + header_off),
+                     header_len);
+
+  // descr
+  size_t dpos = header.find("'descr'");
+  if (dpos == std::string::npos) return false;
+  size_t q1 = header.find('\'', dpos + 7);
+  size_t q2 = header.find('\'', q1 + 1);
+  if (q1 == std::string::npos || q2 == std::string::npos) return false;
+  m->dtype = header.substr(q1 + 1, q2 - q1 - 1);
+
+  // fortran_order must be False (C layout only).
+  size_t fpos = header.find("'fortran_order'");
+  if (fpos != std::string::npos &&
+      header.find("True", fpos) != std::string::npos &&
+      header.find("True", fpos) < header.find(',', fpos)) {
+    return false;
+  }
+
+  // shape tuple
+  size_t spos = header.find("'shape'");
+  if (spos == std::string::npos) return false;
+  size_t p1 = header.find('(', spos);
+  size_t p2 = header.find(')', p1);
+  if (p1 == std::string::npos || p2 == std::string::npos) return false;
+  std::string shape_str = header.substr(p1 + 1, p2 - p1 - 1);
+  m->ndim = 0;
+  const char* s = shape_str.c_str();
+  while (*s) {
+    while (*s == ' ' || *s == ',') s++;
+    if (!*s) break;
+    char* end;
+    long long v = strtoll(s, &end, 10);
+    if (end == s) break;
+    if (m->ndim >= kMaxDims) return false;  // refuse, don't truncate
+    m->shape[m->ndim++] = v;
+    s = end;
+  }
+
+  // element size from descr: trailing integer is the byte width.
+  int itemsize = atoi(m->dtype.c_str() + 2);
+  if (itemsize <= 0) itemsize = 1;
+  int64_t count = 1;
+  for (int i = 0; i < m->ndim; i++) count *= m->shape[i];
+  m->nbytes = count * itemsize;
+
+  m->data = buf + header_off + header_len;
+  if (header_off + header_len + m->nbytes > len) return false;
+  return true;
+}
+
+// ------------------------------------------------------------------- zip/npz
+
+uint16_t rd16(const uint8_t* p) { return p[0] | (p[1] << 8); }
+uint32_t rd32(const uint8_t* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16)
+      | (static_cast<uint32_t>(p[3]) << 24);
+}
+
+bool parse_npz(Reader* r) {
+  const uint8_t* buf = r->map;
+  size_t len = r->map_size;
+  // Find End Of Central Directory (scan back past an optional comment).
+  if (len < 22) return false;
+  size_t eocd = std::string::npos;
+  size_t scan_limit = len >= 22 + 65535 ? len - 22 - 65535 : 0;
+  for (size_t i = len - 22; ; i--) {
+    if (rd32(buf + i) == 0x06054b50) { eocd = i; break; }
+    if (i == scan_limit) break;
+  }
+  if (eocd == std::string::npos) return false;
+  uint16_t n_entries = rd16(buf + eocd + 10);
+  uint32_t cd_offset = rd32(buf + eocd + 16);
+
+  size_t pos = cd_offset;
+  for (int e = 0; e < n_entries; e++) {
+    if (pos + 46 > len || rd32(buf + pos) != 0x02014b50) return false;
+    uint16_t method = rd16(buf + pos + 10);
+    uint32_t comp_size = rd32(buf + pos + 20);
+    uint32_t raw_size = rd32(buf + pos + 24);
+    uint16_t name_len = rd16(buf + pos + 28);
+    uint16_t extra_len = rd16(buf + pos + 30);
+    uint16_t comment_len = rd16(buf + pos + 32);
+    uint32_t local_off = rd32(buf + pos + 42);
+    if (pos + 46 + name_len > len) return false;
+    std::string name(reinterpret_cast<const char*>(buf + pos + 46), name_len);
+    pos += 46 + static_cast<size_t>(name_len) + extra_len + comment_len;
+    if (pos > len) return false;
+
+    // Local header gives the true data offset. Every offset/length from the
+    // file is untrusted: bounds-check before dereferencing, so corrupt files
+    // fail cleanly (Python then falls back to numpy) instead of faulting.
+    if (local_off > len || local_off + 30 > len ||
+        rd32(buf + local_off) != 0x04034b50)
+      return false;
+    uint16_t lname = rd16(buf + local_off + 26);
+    uint16_t lextra = rd16(buf + local_off + 28);
+    size_t payload_off =
+        static_cast<size_t>(local_off) + 30 + lname + lextra;
+    if (payload_off > len || payload_off + comp_size > len) return false;
+    const uint8_t* payload = buf + payload_off;
+
+    Member m;
+    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+      m.name = name.substr(0, name.size() - 4);
+    else
+      m.name = name;
+
+    if (method == 0) {  // stored: zero-copy
+      if (!parse_npy(payload, comp_size, &m)) {
+        r->error = "bad npy member (stored): " + name;
+        return false;
+      }
+    } else if (method == 8) {  // deflated: inflate to owned buffer
+      m.owned.resize(raw_size);
+      z_stream zs;
+      memset(&zs, 0, sizeof(zs));
+      if (inflateInit2(&zs, -MAX_WBITS) != Z_OK) return false;
+      zs.next_in = const_cast<uint8_t*>(payload);
+      zs.avail_in = comp_size;
+      zs.next_out = m.owned.data();
+      zs.avail_out = raw_size;
+      int rc = inflate(&zs, Z_FINISH);
+      inflateEnd(&zs);
+      if (rc != Z_STREAM_END) {
+        r->error = "inflate failed: " + name;
+        return false;
+      }
+      if (!parse_npy(m.owned.data(), raw_size, &m)) {
+        r->error = "bad npy member (deflated): " + name;
+        return false;
+      }
+    } else {
+      r->error = "unsupported zip method for: " + name;
+      return false;
+    }
+    r->members.push_back(std::move(m));
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* er_open(const char* path) {
+  Reader* r = new Reader();
+  r->fd = open(path, O_RDONLY);
+  if (r->fd < 0) { delete r; return nullptr; }
+  struct stat st;
+  if (fstat(r->fd, &st) != 0) { close(r->fd); delete r; return nullptr; }
+  r->map_size = st.st_size;
+  r->map = static_cast<const uint8_t*>(
+      mmap(nullptr, r->map_size, PROT_READ, MAP_PRIVATE, r->fd, 0));
+  if (r->map == MAP_FAILED) { close(r->fd); delete r; return nullptr; }
+  madvise(const_cast<uint8_t*>(r->map), r->map_size, MADV_SEQUENTIAL);
+
+  bool ok;
+  if (r->map_size >= 6 && memcmp(r->map, "\x93NUMPY", 6) == 0) {
+    Member m;
+    m.name = "data";
+    ok = parse_npy(r->map, r->map_size, &m);
+    if (ok) r->members.push_back(std::move(m));
+  } else {
+    ok = parse_npz(r);
+  }
+  if (!ok) {
+    munmap(const_cast<uint8_t*>(r->map), r->map_size);
+    close(r->fd);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int er_num_members(void* handle) {
+  return static_cast<Reader*>(handle)->members.size();
+}
+
+const char* er_member_name(void* handle, int i) {
+  return static_cast<Reader*>(handle)->members[i].name.c_str();
+}
+
+const char* er_member_dtype(void* handle, int i) {
+  return static_cast<Reader*>(handle)->members[i].dtype.c_str();
+}
+
+int er_member_ndim(void* handle, int i) {
+  return static_cast<Reader*>(handle)->members[i].ndim;
+}
+
+void er_member_shape(void* handle, int i, int64_t* out) {
+  const Member& m = static_cast<Reader*>(handle)->members[i];
+  memcpy(out, m.shape, m.ndim * sizeof(int64_t));
+}
+
+const void* er_member_data(void* handle, int i) {
+  return static_cast<Reader*>(handle)->members[i].data;
+}
+
+int64_t er_member_nbytes(void* handle, int i) {
+  return static_cast<Reader*>(handle)->members[i].nbytes;
+}
+
+void er_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r->map) munmap(const_cast<uint8_t*>(r->map), r->map_size);
+  if (r->fd >= 0) close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

@@ -45,6 +45,21 @@ def save_episode(path: str, ep: Episode) -> None:
 
 
 def load_episode(path: str) -> Episode:
+    """Load an episode, preferring the native C++ reader when built.
+
+    The native path (native/episode_reader.cc via ctypes) mmaps the file and
+    parses npy/npz headers in C++ — one syscall + header parse instead of
+    Python-side zipfile machinery per access. Set RT1_TPU_NO_NATIVE=1 to
+    force the numpy path.
+    """
+    if not os.environ.get("RT1_TPU_NO_NATIVE"):
+        try:
+            from rt1_tpu.data import native
+
+            if native.available():
+                return native.load_episode_native(path)
+        except Exception:
+            pass  # fall back to numpy on any native failure
     with np.load(path) as z:
         return {k: z[k] for k in z.files}
 

@@ -1,0 +1,189 @@
+"""ctypes binding for the native episode reader (native/episode_reader.cc).
+
+The shared library is built on demand with g++ (no pybind11 needed). Arrays
+backed by stored (uncompressed) members are zero-copy views into the mmap,
+valid for the lifetime of the `NativeEpisode`; deflated members are owned
+buffers. `load_episode_native` copies into regular numpy arrays by default
+so callers never hold dangling views.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libepisode_reader.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    """Compile under an flock, to a temp name, atomically renamed.
+
+    Concurrent worker processes may race to first use: the lock serializes
+    the `make` runs, and the rename ensures no process ever dlopens (or has
+    mapped) a half-written .so.
+    """
+    try:
+        import fcntl
+
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+        with open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(_LIB_PATH) or _source_newer():
+                    tmp = _LIB_PATH + f".tmp.{os.getpid()}"
+                    subprocess.run(
+                        [
+                            "g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
+                            "-shared",
+                            os.path.join(_NATIVE_DIR, "episode_reader.cc"),
+                            "-lz", "-o", tmp,
+                        ],
+                        check=True,
+                        capture_output=True,
+                    )
+                    os.replace(tmp, _LIB_PATH)
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError):
+        return False
+
+
+def _source_newer() -> bool:
+    """Rebuild when episode_reader.cc is newer than the built library."""
+    src = os.path.join(_NATIVE_DIR, "episode_reader.cc")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+
+
+def get_library() -> Optional[ctypes.CDLL]:
+    """Load (building/rebuilding if needed) the library; None if unavailable."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.er_open.restype = ctypes.c_void_p
+        lib.er_open.argtypes = [ctypes.c_char_p]
+        lib.er_num_members.argtypes = [ctypes.c_void_p]
+        lib.er_member_name.restype = ctypes.c_char_p
+        lib.er_member_name.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.er_member_dtype.restype = ctypes.c_char_p
+        lib.er_member_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.er_member_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.er_member_shape.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.er_member_data.restype = ctypes.c_void_p
+        lib.er_member_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.er_member_nbytes.restype = ctypes.c_int64
+        lib.er_member_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.er_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_library() is not None
+
+
+_DTYPES = {
+    "<f4": np.float32,
+    "<f8": np.float64,
+    "<i4": np.int32,
+    "<i8": np.int64,
+    "<u4": np.uint32,
+    "<u8": np.uint64,
+    "|u1": np.uint8,
+    "|i1": np.int8,
+    "|b1": np.bool_,
+    "<f2": np.float16,
+}
+
+
+class NativeEpisode:
+    """Handle over one open episode file; arrays are materialized on read."""
+
+    def __init__(self, path: str):
+        lib = get_library()
+        if lib is None:
+            raise RuntimeError("native episode reader unavailable")
+        self._lib = lib
+        self._handle = lib.er_open(path.encode())
+        if not self._handle:
+            raise IOError(f"native reader failed to open {path}")
+
+    def keys(self):
+        return [
+            self._lib.er_member_name(self._handle, i).decode()
+            for i in range(self._lib.er_num_members(self._handle))
+        ]
+
+    def _array(self, i: int, copy: bool = True) -> np.ndarray:
+        descr = self._lib.er_member_dtype(self._handle, i).decode()
+        dtype = _DTYPES.get(descr)
+        if dtype is None:
+            raise ValueError(f"unsupported dtype {descr!r}")
+        ndim = self._lib.er_member_ndim(self._handle, i)
+        shape = (ctypes.c_int64 * max(ndim, 1))()
+        self._lib.er_member_shape(self._handle, i, shape)
+        nbytes = self._lib.er_member_nbytes(self._handle, i)
+        ptr = self._lib.er_member_data(self._handle, i)
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype).reshape(tuple(shape[:ndim]))
+        return arr.copy() if copy else arr
+
+    def to_dict(self, copy: bool = True) -> Dict[str, np.ndarray]:
+        return {
+            self._lib.er_member_name(self._handle, i).decode(): self._array(
+                i, copy=copy
+            )
+            for i in range(self._lib.er_num_members(self._handle))
+        }
+
+    def close(self):
+        if self._handle:
+            self._lib.er_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def load_episode_native(path: str) -> Dict[str, np.ndarray]:
+    """Drop-in native replacement for `episodes.load_episode`."""
+    with NativeEpisode(path) as ep:
+        return ep.to_dict(copy=True)

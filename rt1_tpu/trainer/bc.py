@@ -56,8 +56,11 @@ def make_bc_optimizer(
         labels = {}
         for path in flat:
             joined = "/".join(str(p) for p in path)
+            # Match whole path segments: "enc/conv" must not freeze a
+            # sibling like "enc/conv_extra".
             frozen = any(
-                joined.startswith(prefix) for prefix in frozen_prefixes
+                joined == prefix or joined.startswith(prefix + "/")
+                for prefix in frozen_prefixes
             )
             labels[path] = "frozen" if frozen else "trainable"
         return flax.traverse_util.unflatten_dict(labels)

@@ -1,0 +1,87 @@
+"""Native C++ episode reader: build, parse parity, fallback."""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data import episodes as ep_lib
+from rt1_tpu.data import native
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native reader could not be built (no g++/zlib)")
+    return True
+
+
+def _episode(rng):
+    return ep_lib.generate_synthetic_episode(rng, num_steps=5, height=12, width=16)
+
+
+def test_native_matches_numpy_npz(lib_available, tmp_path):
+    rng = np.random.default_rng(0)
+    ep = _episode(rng)
+    path = str(tmp_path / "ep.npz")
+    np.savez(path, **ep)  # stored (uncompressed) members -> zero-copy path
+
+    got = native.load_episode_native(path)
+    assert set(got) == set(ep)
+    for k in ep:
+        np.testing.assert_array_equal(got[k], ep[k])
+        assert got[k].dtype == ep[k].dtype
+
+
+def test_native_matches_numpy_compressed(lib_available, tmp_path):
+    rng = np.random.default_rng(1)
+    ep = _episode(rng)
+    path = str(tmp_path / "ep_c.npz")
+    np.savez_compressed(path, **ep)  # deflated members -> inflate path
+
+    got = native.load_episode_native(path)
+    for k in ep:
+        np.testing.assert_array_equal(got[k], ep[k])
+
+
+def test_native_single_npy(lib_available, tmp_path):
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    path = str(tmp_path / "a.npy")
+    np.save(path, arr)
+    with native.NativeEpisode(path) as h:
+        assert h.keys() == ["data"]
+        got = h.to_dict()["data"]
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_native_open_missing_raises(lib_available, tmp_path):
+    with pytest.raises(IOError):
+        native.NativeEpisode(str(tmp_path / "nope.npz"))
+
+
+def test_load_episode_uses_native_and_fallback(lib_available, tmp_path, monkeypatch):
+    rng = np.random.default_rng(2)
+    ep = _episode(rng)
+    path = str(tmp_path / "ep2.npz")
+    ep_lib.save_episode(path, ep)
+
+    via_default = ep_lib.load_episode(path)
+    monkeypatch.setenv("RT1_TPU_NO_NATIVE", "1")
+    via_numpy = ep_lib.load_episode(path)
+    for k in ep:
+        np.testing.assert_array_equal(via_default[k], via_numpy[k])
+
+
+def test_native_large_random_roundtrip(lib_available, tmp_path):
+    # A bigger mixed-dtype file exercises header sizes and offsets.
+    rng = np.random.default_rng(3)
+    data = {
+        "f32": rng.standard_normal((64, 33)).astype(np.float32),
+        "f64": rng.standard_normal((7,)).astype(np.float64),
+        "u8": rng.integers(0, 255, (31, 9, 3), dtype=np.uint8),
+        "i64": rng.integers(-5, 5, (128,), dtype=np.int64),
+        "bools": rng.integers(0, 2, (17,)).astype(bool),
+    }
+    path = str(tmp_path / "mixed.npz")
+    np.savez(path, **data)
+    got = native.load_episode_native(path)
+    for k, v in data.items():
+        np.testing.assert_array_equal(got[k], v)
