@@ -1,0 +1,152 @@
+"""RLDS -> episode-store conversion (offline, one-shot).
+
+Parity source: reference `rlds_np_convert.py:9-72`: iterate the TFDS
+`language_table_blocktoblock_sim` RLDS dataset, turn each episode's steps
+into arrays (`action`, `is_first`, `is_terminal`, `rgb`, `instruction`),
+replace the byte-encoded instruction with its Universal-Sentence-Encoder
+embedding, and write per-episode files split 7800/100/100.
+
+Differences (documented): output is our `.npz` episode store instead of
+pickled `.npy` step lists, and the embedder is pluggable
+(`rt1_tpu/eval/embedding.py`) since TF-hub/USE weights are not bundled —
+pass `--embedder use` when tensorflow_hub is installed to match the
+reference exactly.
+
+Requires `tensorflow_datasets` (gated import): run where the RLDS dataset
+is materialized.
+
+Run:
+  python -m rt1_tpu.data.convert_rlds --dataset_dir /path/to/rlds \
+      --output_dir /data/language_table_npz --embedder hash
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def decode_instruction_bytes(bytes_array: np.ndarray) -> str:
+    """Strip zero padding and utf-8 decode (reference `decode_inst:9-11`)."""
+    arr = np.asarray(bytes_array)
+    non_zero = arr[arr != 0]
+    if non_zero.shape[0] == 0:
+        return ""
+    return bytes(non_zero.astype(np.uint8).tolist()).decode("utf-8")
+
+
+def episode_from_rlds(rlds_episode, embed_fn) -> Optional[dict]:
+    """One RLDS episode -> our episode dict (None if empty)."""
+    actions, firsts, terminals, rgbs, embeds = [], [], [], [], []
+    cached_embedding = None
+    for step in rlds_episode["steps"].as_numpy_iterator():
+        obs = step["observation"]
+        text = decode_instruction_bytes(obs["instruction"])
+        if cached_embedding is None:
+            # One instruction per episode; embed once
+            # (reference embeds per step, same value each time).
+            cached_embedding = np.asarray(embed_fn(text), np.float32)
+        actions.append(np.asarray(step["action"], np.float32))
+        firsts.append(bool(step["is_first"]))
+        terminals.append(bool(step["is_terminal"]))
+        rgbs.append(np.asarray(obs["rgb"], np.uint8))
+        embeds.append(cached_embedding)
+    if not actions:
+        return None
+    return {
+        "action": np.stack(actions),
+        "is_first": np.array(firsts),
+        "is_terminal": np.array(terminals),
+        "rgb": np.stack(rgbs),
+        "instruction": np.stack(embeds),
+    }
+
+
+def convert(
+    dataset_dir: str,
+    output_dir: str,
+    embedder="hash",
+    num_train: int = 7800,
+    num_val: int = 100,
+    num_test: int = 100,
+    progress_every: int = 100,
+):
+    """Convert the RLDS dataset into train/val/test episode directories."""
+    try:
+        import tensorflow_datasets as tfds
+    except ImportError as e:
+        raise ImportError(
+            "RLDS conversion requires tensorflow_datasets; install it or "
+            "use `python -m rt1_tpu.data.collect` to generate data with "
+            "the scripted oracle instead."
+        ) from e
+
+    from rt1_tpu.data.episodes import save_episode
+    from rt1_tpu.eval.embedding import get_embedder
+
+    embed_fn = get_embedder(embedder)
+    builder = tfds.builder_from_directory(dataset_dir)
+    total = num_train + num_val + num_test
+    ds = builder.as_dataset(split=f"train[:{total}]")
+
+    splits = (
+        ("train", num_train),
+        ("val", num_val),
+        ("test", num_test),
+    )
+    for name, _ in splits:
+        os.makedirs(os.path.join(output_dir, name), exist_ok=True)
+
+    split_iter = iter(splits)
+    split_name, split_quota = next(split_iter)
+    split_count = 0
+    written = 0
+    for rlds_episode in ds:
+        ep = episode_from_rlds(rlds_episode, embed_fn)
+        if ep is None:
+            continue
+        while split_count >= split_quota:
+            split_name, split_quota = next(split_iter)
+            split_count = 0
+        save_episode(
+            os.path.join(
+                output_dir, split_name, f"episode_{split_count}.npz"
+            ),
+            ep,
+        )
+        split_count += 1
+        written += 1
+        if progress_every and written % progress_every == 0:
+            print(f"converted {written}/{total}")
+    return written
+
+
+def main(argv):
+    del argv
+    from absl import flags
+
+    FLAGS = flags.FLAGS
+    n = convert(
+        FLAGS.dataset_dir,
+        FLAGS.output_dir,
+        embedder=FLAGS.embedder,
+        num_train=FLAGS.num_train,
+        num_val=FLAGS.num_val,
+        num_test=FLAGS.num_test,
+    )
+    print(f"done: {n} episodes")
+
+
+if __name__ == "__main__":
+    from absl import app, flags
+
+    flags.DEFINE_string("dataset_dir", None, "RLDS dataset directory.")
+    flags.DEFINE_string("output_dir", None, "Episode-store output dir.")
+    flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    flags.DEFINE_integer("num_train", 7800, "Train episodes.")
+    flags.DEFINE_integer("num_val", 100, "Val episodes.")
+    flags.DEFINE_integer("num_test", 100, "Test episodes.")
+    flags.mark_flags_as_required(["dataset_dir", "output_dir"])
+    app.run(main)

@@ -27,12 +27,16 @@ _build_failed = False
 
 
 def _build() -> bool:
-    """Compile under an flock, to a temp name, atomically renamed.
+    """Ensure the library exists and is current; compile when needed.
 
-    Concurrent worker processes may race to first use: the lock serializes
-    the `make` runs, and the rename ensures no process ever dlopens (or has
-    mapped) a half-written .so.
+    The freshness check runs BEFORE any write (a read-only install with a
+    prebuilt current .so must work). Compilation happens under an flock so
+    racing worker processes serialize, to a temp name atomically renamed so
+    no process ever dlopens (or has mapped) a half-written .so. The command
+    mirrors native/Makefile (kept for manual/dev builds).
     """
+    if os.path.exists(_LIB_PATH) and not _source_newer():
+        return True
     try:
         import fcntl
 
@@ -40,6 +44,7 @@ def _build() -> bool:
         with open(lock_path, "w") as lock_f:
             fcntl.flock(lock_f, fcntl.LOCK_EX)
             try:
+                # Re-check under the lock: another process may have built.
                 if not os.path.exists(_LIB_PATH) or _source_newer():
                     tmp = _LIB_PATH + f".tmp.{os.getpid()}"
                     subprocess.run(

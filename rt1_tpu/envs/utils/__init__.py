@@ -1,0 +1,6 @@
+"""Environment utilities: 6-DOF poses + xArm kinematics."""
+
+from rt1_tpu.envs.utils.pose3d import Pose3d
+from rt1_tpu.envs.utils.xarm import XArmKinematics
+
+__all__ = ["Pose3d", "XArmKinematics"]

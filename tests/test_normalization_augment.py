@@ -1,0 +1,149 @@
+"""Chan statistics + photometric augmentation tests."""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data.normalization import (
+    ChanRunningStatistics,
+    chan_merge,
+    compute_dataset_statistics,
+    get_or_compute_statistics,
+)
+
+
+def test_chan_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((1000, 7)) * 3.0 + 2.0
+    stats = ChanRunningStatistics()
+    for chunk in np.array_split(data, 13):
+        stats.update(chunk)
+    np.testing.assert_allclose(stats.mean, data.mean(axis=0), rtol=1e-10)
+    np.testing.assert_allclose(stats.std, data.std(axis=0), rtol=1e-10)
+    assert stats.n == 1000
+
+
+def test_chan_merge_associative():
+    rng = np.random.default_rng(1)
+    a, b = rng.standard_normal((50, 3)), rng.standard_normal((70, 3))
+    na, ma, m2a = len(a), a.mean(0), a.var(0) * len(a)
+    nb, mb, m2b = len(b), b.mean(0), b.var(0) * len(b)
+    n, mean, m2 = chan_merge(na, ma, m2a, nb, mb, m2b)
+    full = np.concatenate([a, b])
+    np.testing.assert_allclose(mean, full.mean(0), rtol=1e-10)
+    np.testing.assert_allclose(m2 / n, full.var(0), rtol=1e-10)
+
+
+def test_compute_dataset_statistics():
+    rng = np.random.default_rng(2)
+
+    def batches():
+        while True:
+            yield {
+                "observations": {
+                    "natural_language_embedding": rng.standard_normal(
+                        (4, 3, 8)
+                    )
+                },
+                "actions": {"action": rng.uniform(-0.1, 0.1, (4, 3, 2))},
+            }
+
+    stats = compute_dataset_statistics(batches(), num_samples=200)
+    assert stats["num_samples"] >= 200
+    act = stats["act_statistics"]
+    assert len(act["mean"]) == 2
+    assert all(m <= 0.1 for m in act["max"])
+    assert all(m >= -0.1 for m in act["min"])
+    emb = stats["obs_statistics"]["natural_language_embedding"]
+    assert len(emb["mean"]) == 8
+    assert all(s > 0 for s in emb["std"])
+
+
+def test_rendezvous_lead_writes_follower_reads(tmp_path):
+    path = str(tmp_path / "stats.json")
+    computed = {"x": [1.0, 2.0]}
+    out = get_or_compute_statistics(path, lambda: computed, is_lead_host=True)
+    assert out == computed
+    # Follower finds the file immediately.
+    out2 = get_or_compute_statistics(
+        path, lambda: {"not": "used"}, is_lead_host=False, timeout_s=2
+    )
+    assert out2 == computed
+
+
+def test_rendezvous_follower_timeout(tmp_path):
+    with pytest.raises(TimeoutError):
+        get_or_compute_statistics(
+            str(tmp_path / "never.json"),
+            lambda: {},
+            is_lead_host=False,
+            timeout_s=0.2,
+            poll_s=0.05,
+        )
+
+
+class TestPhotometric:
+    def _images(self, seed=0):
+        import jax
+
+        rng = np.random.default_rng(seed)
+        return np.clip(rng.random((2, 8, 8, 3)), 0.01, 0.99).astype(
+            np.float32
+        )
+
+    def test_hsv_roundtrip(self):
+        from rt1_tpu.ops.augment import hsv_to_rgb, rgb_to_hsv
+
+        imgs = self._images()
+        back = np.asarray(hsv_to_rgb(rgb_to_hsv(imgs)))
+        np.testing.assert_allclose(back, imgs, atol=1e-5)
+
+    def test_brightness_contrast_semantics(self):
+        import jax.numpy as jnp
+
+        from rt1_tpu.ops.augment import adjust_brightness, adjust_contrast
+
+        imgs = self._images()
+        brighter = np.asarray(adjust_brightness(jnp.asarray(imgs), 0.2))
+        assert (brighter >= imgs - 1e-6).all()
+        # Contrast factor 1 is identity.
+        same = np.asarray(adjust_contrast(jnp.asarray(imgs), 1.0))
+        np.testing.assert_allclose(same, imgs, atol=1e-6)
+        # Factor 0 collapses to the mean.
+        flat = np.asarray(adjust_contrast(jnp.asarray(imgs), 0.0))
+        assert flat.std() < imgs.std()
+
+    def test_saturation_zero_grayscale(self):
+        import jax.numpy as jnp
+
+        from rt1_tpu.ops.augment import adjust_saturation
+
+        gray = np.asarray(adjust_saturation(jnp.asarray(self._images()), 0.0))
+        np.testing.assert_allclose(gray[..., 0], gray[..., 1], atol=1e-5)
+        np.testing.assert_allclose(gray[..., 1], gray[..., 2], atol=1e-5)
+
+    def test_hue_full_rotation_identity(self):
+        import jax.numpy as jnp
+
+        from rt1_tpu.ops.augment import adjust_hue
+
+        imgs = self._images()
+        rotated = np.asarray(adjust_hue(jnp.asarray(imgs), 1.0))
+        np.testing.assert_allclose(rotated, imgs, atol=1e-5)
+
+    def test_full_distortion_pipeline(self):
+        import jax
+
+        from rt1_tpu.ops.augment import photometric_distortions
+
+        imgs = self._images()
+        out = np.asarray(
+            photometric_distortions(imgs, jax.random.PRNGKey(0))
+        )
+        assert out.shape == imgs.shape
+        assert np.isfinite(out).all()
+        assert out.min() >= 0.0 and out.max() <= 1.0
+        # Distinct keys give distinct augmentations.
+        out2 = np.asarray(
+            photometric_distortions(imgs, jax.random.PRNGKey(1))
+        )
+        assert not np.allclose(out, out2)
