@@ -96,6 +96,7 @@ class RT1Policy(nn.Module):
     use_token_learner: bool = True
     num_image_tokens: int = 8
     crop_ratio: float = 0.07          # pad-and-random-shift ratio (preprocessors.py:37)
+    photometric_augmentation: bool = False  # on-device color jitter (train only)
     loss_scale: str = "reference"     # 'reference' (:314-319) or 'mean'
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -169,12 +170,20 @@ class RT1Policy(nn.Module):
         train gate). We crop only when `train=True` — deterministic eval.
         """
         do_crop = train and self.crop_ratio > 0
-        return image_ops.convert_dtype_and_crop_images(
+        image = image_ops.convert_dtype_and_crop_images(
             image,
             rng=self.make_rng("crop") if do_crop else None,
             ratio=self.crop_ratio,
             train=do_crop,
         )
+        if train and self.photometric_augmentation:
+            # On-device color jitter (Stack B's PhotometricDistortions,
+            # `input_pipeline_rlds.py:391-457`), fused into the forward so
+            # the host pipeline stays augmentation-free.
+            from rt1_tpu.ops.augment import photometric_distortions
+
+            image = photometric_distortions(image, self.make_rng("crop"))
+        return image
 
     def _tokenize_images(
         self, image: jnp.ndarray, context: Optional[jnp.ndarray], train: bool

@@ -26,6 +26,7 @@ def get_config():
     config.model.num_image_tokens = 8
     config.model.image_tokenizer = "efficientnet_b3"
     config.model.dtype = "bfloat16"
+    config.model.photometric_augmentation = False
 
     # Data.
     config.data = ml_collections.ConfigDict()

@@ -62,6 +62,9 @@ def build_model(model_config):
         use_token_learner=model_config.use_token_learner,
         num_image_tokens=model_config.num_image_tokens,
         image_tokenizer_def=tokenizer_def,
+        photometric_augmentation=model_config.get(
+            "photometric_augmentation", False
+        ),
         dtype=jnp.bfloat16
         if model_config.dtype == "bfloat16"
         else jnp.float32,
