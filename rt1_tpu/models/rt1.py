@@ -100,11 +100,14 @@ class RT1Policy(nn.Module):
     loss_scale: str = "reference"     # 'reference' (:314-319) or 'mean'
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
-    # "dense" (default) or "ring": ring attention shards the token sequence
-    # over the mesh's ``seq`` axis (sequence/context parallelism for
-    # long-horizon variants; requires `mesh` with a >1 seq axis).
+    # "dense" (default), "ring", or "pallas". "ring" shards the token
+    # sequence over the mesh's ``seq`` axis (sequence/context parallelism
+    # for long-horizon variants; requires `mesh` with a >1 seq axis).
+    # "pallas" fuses inference attention into one VMEM kernel on TPU
+    # (training and non-TPU backends fall back to dense).
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
+    pallas_interpret: bool = False  # test-only: run the kernel off-TPU
     # Optional custom image tokenizer module (must map (b,t,H,W,3), (b,t,D) →
     # (b,t,num_image_tokens,token_embedding_size)); used by tests to swap the
     # EfficientNet-B3 backbone for a tiny one.
@@ -152,6 +155,7 @@ class RT1Policy(nn.Module):
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             mesh=self.mesh,
+            pallas_interpret=self.pallas_interpret,
         )
         self._mask = rt1_attention_mask(
             self.time_sequence_length, self.tokens_per_image, self.tokens_per_action

@@ -43,8 +43,14 @@ class TFMultiHeadAttention(nn.Module):
     d_model: int
     dropout_rate: float = 0.1
     dtype: jnp.dtype = jnp.float32
-    attention_impl: str = "dense"     # "dense" | "ring"
+    # "dense" | "ring" | "pallas". "ring" needs `mesh` with a >1 seq axis;
+    # "pallas" is the fused inference kernel — used only when train=False on
+    # a TPU backend (gradients and non-TPU backends fall back to dense).
+    attention_impl: str = "dense"
     mesh: Optional[Any] = None
+    # Test escape hatch: run the pallas kernel in interpreter mode off-TPU
+    # (orders of magnitude slower than dense; never set in production).
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(
@@ -58,6 +64,32 @@ class TFMultiHeadAttention(nn.Module):
         q = nn.Dense(h * k, dtype=self.dtype, name="query")(x).reshape(b, s, h, k)
         kk = nn.Dense(h * k, dtype=self.dtype, name="key")(x).reshape(b, s, h, k)
         v = nn.Dense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
+
+        import jax as _jax
+
+        use_pallas = (
+            self.attention_impl == "pallas"
+            and not train  # forward-only kernel: no autodiff rule
+            and (
+                _jax.default_backend() == "tpu" or self.pallas_interpret
+            )
+        )
+        if use_pallas:
+            # Fused VMEM kernel (rt1_tpu/parallel/flash_attention.py).
+            from rt1_tpu.parallel.flash_attention import fused_attention
+
+            if mask is not None and mask.ndim != 2:
+                raise ValueError("pallas attention supports (s, s) masks only")
+            out = fused_attention(
+                q,
+                kk,
+                v,
+                mask=mask,
+                scale=1.0 / float(k) ** 0.5,
+                interpret=_jax.default_backend() != "tpu",
+            )
+            out = out.reshape(b, s, h * k)
+            return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), None
 
         use_ring = (
             self.attention_impl == "ring"
@@ -107,6 +139,7 @@ class TransformerLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -119,6 +152,7 @@ class TransformerLayer(nn.Module):
             dtype=self.dtype,
             attention_impl=self.attention_impl,
             mesh=self.mesh,
+            pallas_interpret=self.pallas_interpret,
             name="attn",
         )(y, mask=mask, train=train)
         x = x + attn_out
@@ -142,6 +176,7 @@ class CausalTransformer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
+    pallas_interpret: bool = False
 
     @nn.compact
     def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
@@ -151,10 +186,14 @@ class CausalTransformer(nn.Module):
             raise ValueError(
                 f"sequence length {s} exceeds max_seq_len={self.max_seq_len}"
             )
-        if self.return_attention_scores and self.attention_impl == "ring":
+        if self.return_attention_scores and self.attention_impl in (
+            "ring",
+            "pallas",
+        ):
             raise ValueError(
-                "attention scores are not materialized under ring attention; "
-                "use attention_impl='dense' for score visualization"
+                "attention scores are not materialized under ring/pallas "
+                "attention; use attention_impl='dense' for score "
+                "visualization"
             )
         x = nn.Dense(self.d_model, dtype=self.dtype, name="token_emb")(inputs)
         pos_emb = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype, name="position_emb")(
@@ -171,6 +210,7 @@ class CausalTransformer(nn.Module):
                 dtype=self.dtype,
                 attention_impl=self.attention_impl,
                 mesh=self.mesh,
+                pallas_interpret=self.pallas_interpret,
                 name=f"layer_{i}",
             )(x, mask=attention_mask, train=train)
             if self.return_attention_scores:
