@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import time
 
 
@@ -34,11 +33,9 @@ def main():
     # Persistent compilation cache: repeated bench runs (and the driver's
     # round-end run) skip the multi-minute first compile of the full B3
     # graph over the axon tunnel.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from rt1_tpu.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     import jax.numpy as jnp
 
     from rt1_tpu.models.rt1 import RT1Policy

@@ -1,0 +1,23 @@
+"""Persistent XLA compilation-cache setup shared by the repo entry points.
+
+First compile of the full B3+transformer train step costs minutes (CPU
+backend for the multichip dry-run, remote tunnel for the TPU bench); the
+on-disk cache makes every later process start in seconds. Used by
+`bench.py`, `__graft_entry__.py`, and available to user scripts.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+
+
+def enable_persistent_cache(cache_dir: str = DEFAULT_CACHE_DIR) -> None:
+    """Point JAX's compilation cache at `cache_dir` (created on demand)."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

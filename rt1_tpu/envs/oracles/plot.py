@@ -1,0 +1,141 @@
+"""Planner / oracle debug visualization.
+
+Capability parity with the reference's `language_table/environments/oracles/
+plot.py` (matplotlib scatter of RRT* tree, obstacles, and planned path, used
+while tuning the push oracle), rebuilt on PIL so it shares the coordinate
+mapping and dependency footprint of `rt1_tpu/envs/rendering.py` — the frames
+compose directly with `render_board` output and can go straight into the
+eval-video writer (`rt1_tpu/eval/evaluate.py`).
+
+All drawing is in board/world coordinates; `image_size` is (height, width).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+from PIL import Image, ImageDraw
+
+from rt1_tpu.envs import constants
+from rt1_tpu.envs.rendering import _scale, _world_to_px
+
+TREE_COLOR = (120, 200, 255, 110)
+OBSTACLE_COLOR = (230, 90, 70, 90)
+OBSTACLE_EDGE = (230, 90, 70, 220)
+PATH_COLOR = (255, 230, 60, 255)
+START_COLOR = (60, 220, 90, 255)
+GOAL_COLOR = (255, 90, 200, 255)
+
+
+def _blank_board(image_size):
+    h, w = image_size
+    img = Image.new("RGB", (w, h), (40, 40, 45))
+    draw = ImageDraw.Draw(img, "RGBA")
+    x0, y0 = _world_to_px((constants.X_MIN, constants.Y_MIN), image_size)
+    x1, y1 = _world_to_px((constants.X_MAX, constants.Y_MAX), image_size)
+    draw.rectangle([x0, y0, x1, y1], fill=(90, 90, 95))
+    return img, draw
+
+
+def draw_planner(
+    planner,
+    image: Optional[np.ndarray] = None,
+    image_size=(360, 640),
+    show_tree: bool = True,
+) -> np.ndarray:
+    """Render an `RRTStarPlanner` (tree, obstacles, path) to an RGB array.
+
+    Args:
+      planner: a planned `rt1_tpu.envs.oracles.rrt_star.RRTStarPlanner`
+        (after `.plan()`; a failed plan still draws its tree + obstacles).
+      image: optional background frame (e.g. `render_board` output) to draw
+        over; resized to `image_size`.
+      image_size: (height, width) of the output.
+      show_tree: include the expanded tree edges, not just the path.
+    """
+    if image is not None:
+        img = Image.fromarray(np.asarray(image, np.uint8)).resize(
+            (image_size[1], image_size[0]), Image.BILINEAR
+        )
+        draw = ImageDraw.Draw(img, "RGBA")
+    else:
+        img, draw = _blank_board(image_size)
+    px_per_m = _scale(image_size)
+
+    # Inflated obstacles as seen by the collision checker.
+    for c, r in zip(planner.obstacles, planner.radii):
+        cx, cy = _world_to_px(c, image_size)
+        pr = float(r) * px_per_m
+        draw.ellipse(
+            [cx - pr, cy - pr, cx + pr, cy + pr],
+            fill=OBSTACLE_COLOR,
+            outline=OBSTACLE_EDGE,
+        )
+
+    if show_tree and len(planner.tree_points):
+        pts_px = [_world_to_px(p, image_size) for p in planner.tree_points]
+        for i, par in enumerate(planner.tree_parent):
+            if par < 0:
+                continue
+            draw.line([pts_px[int(par)], pts_px[i]], fill=TREE_COLOR, width=1)
+
+    draw_path(img, planner.path, image_size=image_size)
+
+    for p, color in ((planner.start, START_COLOR), (planner.goal, GOAL_COLOR)):
+        cx, cy = _world_to_px(p, image_size)
+        draw.ellipse([cx - 4, cy - 4, cx + 4, cy + 4], fill=color)
+
+    return np.asarray(img, dtype=np.uint8)
+
+
+def draw_path(
+    img,
+    path: Sequence[Sequence[float]],
+    image_size=(360, 640),
+    color=PATH_COLOR,
+) -> None:
+    """Draw a subgoal polyline (planner `path` is goal->start order) onto a
+    PIL image in place."""
+    if path is None or len(path) < 2:
+        return
+    draw = ImageDraw.Draw(img, "RGBA")
+    px = [_world_to_px(p, image_size) for p in path]
+    draw.line(px, fill=color, width=2)
+    for p in px:
+        draw.ellipse([p[0] - 2, p[1] - 2, p[0] + 2, p[1] + 2], fill=color)
+
+
+def draw_oracle_plan(
+    oracle,
+    raw_state,
+    image: Optional[np.ndarray] = None,
+    image_size=(360, 640),
+) -> np.ndarray:
+    """Visualize an `RRTPushOracle`'s current block plan for `raw_state`.
+
+    Plans with the oracle's own `get_plan` (same obstacles/parameters the
+    eval init-validation uses, `rt1_tpu/eval/evaluate.py`), then draws the
+    block subgoal sequence over the board. The oracle's planning state
+    (`_plan`, `_current_rrt_target`, `_need_replan`) and RNG stream are
+    snapshotted and restored, so per-frame visualization during a rollout
+    does not change the oracle's subsequent actions.
+    """
+    saved = (oracle._plan, oracle._current_rrt_target, oracle._need_replan)
+    rng_state = oracle._rng.get_state()
+    try:
+        oracle.get_plan(raw_state)
+        path = [list(p) for p in oracle._plan] + [
+            list(oracle._current_rrt_target)
+        ]
+    finally:
+        oracle._plan, oracle._current_rrt_target, oracle._need_replan = saved
+        oracle._rng.set_state(rng_state)
+    if image is None:
+        img, _ = _blank_board(image_size)
+    else:
+        img = Image.fromarray(np.asarray(image, np.uint8)).resize(
+            (image_size[1], image_size[0]), Image.BILINEAR
+        )
+    draw_path(img, path, image_size=image_size)
+    return np.asarray(img, dtype=np.uint8)

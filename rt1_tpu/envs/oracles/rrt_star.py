@@ -86,6 +86,9 @@ class RRTStarPlanner:
         self.rng = rng or np.random
         self.success = False
         self.path = []
+        # Filled by plan() on success; consumed by oracles/plot.py.
+        self.tree_points = np.zeros((0, 2))
+        self.tree_parent = np.zeros((0,), dtype=np.int64)
 
     def _collision_free(self, p0, p1):
         if _inside_circles(p1, self.obstacles, self.radii):
@@ -165,6 +168,11 @@ class RRTStarPlanner:
                 parent[n] = near_i
                 cost[n] = cost[near_i] + step
             n += 1
+
+        # Retain the tree for debug visualization (oracles/plot.py) — saved
+        # before goal connection so failed plans can be inspected too.
+        self.tree_points = pts[:n].copy()
+        self.tree_parent = parent[:n].copy()
 
         # Connect the tree to the goal.
         gd = np.linalg.norm(pts[:n] - self.goal, axis=1)

@@ -103,3 +103,59 @@ def test_oracle_plan_success_on_fresh_board():
     env.reset()
     assert oracle.get_plan(env.compute_state()) in (True, False)
     assert oracle._current_rrt_target is not None
+
+
+def test_planner_plot_renders_tree_and_path():
+    from rt1_tpu.envs.oracles.rrt_star import RRTStarPlanner
+    from rt1_tpu.envs.oracles import plot
+
+    rng = np.random.RandomState(0)
+    planner = RRTStarPlanner(
+        start=(0.2, -0.2),
+        goal=(0.55, 0.25),
+        x_range=(0.15, 0.64),
+        y_range=(-0.34, 0.34),
+        obstacle_xy=[(0.375, 0.025)],
+        obstacle_radii=[0.03],
+        delta=0.015,
+        step_length=0.05,
+        goal_sample_rate=0.1,
+        search_radius=0.5,
+        iter_max=512,
+        rng=rng,
+    ).plan()
+    assert planner.success
+    assert len(planner.tree_points) == len(planner.tree_parent) > 1
+
+    img = plot.draw_planner(planner, image_size=(180, 320))
+    assert img.shape == (180, 320, 3) and img.dtype == np.uint8
+    # The drawing actually changed pixels relative to an empty board.
+    blank = plot.draw_planner(
+        RRTStarPlanner(
+            start=(0.2, -0.2), goal=(0.55, 0.25),
+            x_range=(0.15, 0.64), y_range=(-0.34, 0.34),
+            obstacle_xy=[], obstacle_radii=[], delta=0.015,
+            step_length=0.05, goal_sample_rate=0.1, search_radius=0.5,
+            iter_max=1, rng=np.random.RandomState(0),
+        ),
+        image_size=(180, 320),
+        show_tree=False,
+    )
+    assert (img != blank).any()
+
+
+def test_oracle_plan_plot_over_board_frame():
+    from rt1_tpu.envs.oracles import plot
+
+    env = LanguageTable(
+        block_mode=blocks.BlockMode.BLOCK_4,
+        reward_factory=BlockToBlockReward,
+        seed=11,
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=0)
+    env.reset()
+    frame = env.render()
+    img = plot.draw_oracle_plan(
+        oracle, env.compute_state(), image=frame, image_size=(180, 320)
+    )
+    assert img.shape == (180, 320, 3) and img.dtype == np.uint8
