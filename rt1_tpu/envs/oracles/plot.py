@@ -17,7 +17,7 @@ from typing import Optional, Sequence
 import numpy as np
 from PIL import Image, ImageDraw
 
-from rt1_tpu.envs import constants
+from rt1_tpu.envs import constants, rendering
 from rt1_tpu.envs.rendering import _scale, _world_to_px
 
 TREE_COLOR = (120, 200, 255, 110)
@@ -29,12 +29,14 @@ GOAL_COLOR = (255, 90, 200, 255)
 
 
 def _blank_board(image_size):
+    """Empty board in the palette of `rendering.render_board` so debug frames
+    compose consistently with real board frames."""
     h, w = image_size
-    img = Image.new("RGB", (w, h), (40, 40, 45))
+    img = Image.new("RGB", (w, h), rendering.BORDER_COLOR)
     draw = ImageDraw.Draw(img, "RGBA")
     x0, y0 = _world_to_px((constants.X_MIN, constants.Y_MIN), image_size)
     x1, y1 = _world_to_px((constants.X_MAX, constants.Y_MAX), image_size)
-    draw.rectangle([x0, y0, x1, y1], fill=(90, 90, 95))
+    draw.rectangle([x0, y0, x1, y1], fill=rendering.BOARD_COLOR)
     return img, draw
 
 

@@ -34,8 +34,14 @@ def get_config():
     config.data.height = 256
     config.data.width = 456
     config.data.crop_factor = 0.95
-    config.data.loader = "tf"  # "tf" | "numpy"
+    # "tf": numpy_function-backed local pipeline; "rlds_tf": pure-TF graph
+    # (tf.data-service-distributable); "numpy": dependency-free iterator.
+    config.data.loader = "tf"
     config.data.shuffle_buffer = 2048
+    # tf.data service endpoint for distributed preprocessing with the
+    # "rlds_tf" loader (reference input_pipeline_rlds.py:307-317); None =
+    # process batches locally.
+    config.data.data_service_address = ml_collections.config_dict.placeholder(str)
 
     # Training schedule (reference: 100 epochs x 975 steps at batch 8).
     config.per_host_batch_size = 8

@@ -106,6 +106,36 @@ def dataset_batches(config, split="train") -> Iterator:
         raise FileNotFoundError(
             f"No episodes under {config.data.data_dir}/{split}"
         )
+    if config.data.loader == "rlds_tf":
+        # Pure-TF windowing pipeline: episodes stream lazily from the npz
+        # store (one read per generator pull, bounded host memory) into the
+        # same window/crop graph the direct-RLDS path uses
+        # (rt1_tpu/data/rlds_pipeline.py). tf.data service with this loader
+        # is limited to in-process/colocated workers (generator source);
+        # use create_rlds_datasets + InGraphTableEmbedder for remote ones.
+        from rt1_tpu.data.rlds_pipeline import (
+            RldsPipelineConfig,
+            make_episode_dataset_from_paths,
+            windowed_rlds_dataset,
+        )
+
+        host_paths = paths[jax.process_index() :: jax.process_count()]
+        cfg = RldsPipelineConfig(
+            window=config.model.time_sequence_length,
+            crop_factor=config.data.crop_factor,
+            height=config.data.height,
+            width=config.data.width,
+            batch_size=config.per_host_batch_size,
+            shuffle_buffer=config.data.shuffle_buffer,
+            seed=config.seed,
+            data_service_address=config.data.get("data_service_address"),
+        )
+        tfds = windowed_rlds_dataset(
+            make_episode_dataset_from_paths(host_paths), cfg,
+            training=split == "train",
+        )
+        return iter(tfds.as_numpy_iterator())
+
     ds = WindowedEpisodeDataset(
         paths,
         window=config.model.time_sequence_length,
