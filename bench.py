@@ -26,6 +26,10 @@ def main():
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--height", type=int, default=256)
     p.add_argument("--width", type=int, default=456)
+    # "train": train-step throughput (the driver's metric). "infer": closed-
+    # loop control-step latency of the jitted single-pass infer_step at
+    # batch 1 (the reference's 10 Hz budget, SURVEY.md §7 hard part 3).
+    p.add_argument("--mode", default="train", choices=["train", "infer"])
     args = p.parse_args()
 
     import jax
@@ -60,6 +64,9 @@ def main():
         language_table_action_space(), jax.random.fold_in(rng, 2), (b, t)
     )
 
+    if args.mode == "infer":
+        return infer_bench(args, model, rng, obs, actions)
+
     n_chips = len(jax.devices())
     mesh = make_mesh(MeshConfig())
     tx = make_optimizer(steps_per_epoch=975)  # 7800 episodes / batch 8 (reference)
@@ -93,6 +100,58 @@ def main():
                 "value": round(steps_per_sec_per_chip, 4),
                 "unit": "steps/s/chip",
                 "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+def infer_bench(args, model, rng, obs, actions):
+    """Control-step latency: one jitted infer_step per tick at batch 1.
+
+    The reference's inference loop runs `tokens_per_action` (=3) full
+    transformer passes per 10 Hz control step on GPU
+    (`transformer_network.py:246-268`); ours is a single fused pass with a
+    donated rolling state. Prints median latency in ms.
+    """
+    import statistics
+    import jax
+
+    # Parameter shapes are batch-independent: init at batch 1 / one frame of
+    # context so startup does 1/48th of the full-batch tokenization work.
+    obs1 = jax.tree.map(lambda x: x[:1, :1], obs)
+    actions1 = jax.tree.map(lambda x: x[:1, :1], actions)
+    model1 = model.clone(time_sequence_length=1)
+    variables = model1.init({"params": rng, "crop": rng}, obs1, actions1, train=False)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step(variables, observation, state):
+        return model.apply(variables, observation, state, method=model.infer_step)
+
+    frame = {
+        "image": obs["image"][:1, 0],
+        "natural_language_embedding": obs["natural_language_embedding"][:1, 0],
+    }
+    state = model.initial_state(batch_size=1)
+    for _ in range(max(args.warmup, 1)):
+        out, state = step(variables, frame, state)
+    jax.block_until_ready(out["action_tokens"])
+
+    times = []
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        out, state = step(variables, frame, state)
+        jax.block_until_ready(out["action_tokens"])
+        times.append((time.perf_counter() - t0) * 1000.0)
+    p50 = statistics.median(times)
+    print(
+        json.dumps(
+            {
+                "metric": "infer_step_latency_p50",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": 1.0,
             }
         )
     )

@@ -69,3 +69,16 @@ def get_config():
     config.eval_batches = 6
 
     return config
+
+
+def sweep():
+    """Hyperparameter sweep hook (the open equivalent of the reference's
+    `get_hyper` product-sweep, `configs/language_table_sim_local.py:84-89`):
+    a list of {dotted-config-key: value} override dicts, one trial each.
+    Apply with `config.update_from_flattened_dict(overrides)` or pass as
+    `--config.<key>=<value>` CLI overrides per trial."""
+    return [
+        {"learning_rate": lr, "seed": seed}
+        for lr in (1e-3, 5e-4, 1e-4)
+        for seed in (42,)
+    ]

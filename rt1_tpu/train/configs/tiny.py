@@ -2,6 +2,9 @@
 
 from rt1_tpu.train.configs import language_table
 
+# Sweep hook shared with the full config (--sweep_trial N applies one trial).
+sweep = language_table.sweep
+
 
 def get_config():
     config = language_table.get_config()

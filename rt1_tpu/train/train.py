@@ -273,13 +273,38 @@ def train_and_evaluate(config, workdir: str):
     return state
 
 
+def apply_sweep_trial(config, config_module, trial: int):
+    """Apply trial `trial` of the config module's `sweep()` (the open
+    equivalent of the reference's `get_hyper` hook,
+    `configs/language_table_sim_local.py:84-89`) onto `config` in place."""
+    trials = config_module.sweep()
+    if not 0 <= trial < len(trials):
+        raise ValueError(f"--sweep_trial {trial} out of range [0, {len(trials)})")
+    overrides = trials[trial]
+    with config.unlocked():
+        config.update_from_flattened_dict(overrides)
+    return overrides
+
+
 def main(argv):
     del argv
-    from absl import flags
+    import importlib.util
+
+    from absl import flags, logging
     from ml_collections import config_flags
 
     FLAGS = flags.FLAGS
-    train_and_evaluate(FLAGS.config, FLAGS.workdir)
+    config = FLAGS.config
+    if FLAGS.sweep_trial >= 0:
+        module_name = config_flags.get_config_filename(FLAGS["config"])
+        spec = importlib.util.spec_from_file_location("sweep_cfg", module_name)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "sweep"):
+            raise ValueError(f"{module_name} defines no sweep()")
+        overrides = apply_sweep_trial(config, mod, FLAGS.sweep_trial)
+        logging.info("sweep trial %d: %s", FLAGS.sweep_trial, overrides)
+    train_and_evaluate(config, FLAGS.workdir)
 
 
 if __name__ == "__main__":
@@ -288,5 +313,9 @@ if __name__ == "__main__":
 
     config_flags.DEFINE_config_file("config", None, "Config file.", lock_config=True)
     flags.DEFINE_string("workdir", "/tmp/rt1_tpu", "Work/output directory.")
+    flags.DEFINE_integer(
+        "sweep_trial", -1,
+        "If >= 0, apply this trial of the config module's sweep() before "
+        "training (one process per trial).")
     flags.mark_flags_as_required(["config"])
     app.run(main)
