@@ -14,7 +14,10 @@ Run:
 
 from __future__ import annotations
 
+import functools
+import json
 import os
+import shutil
 
 import numpy as np
 
@@ -94,12 +97,7 @@ def collect_dataset(
     embed_fn = get_embedder(embedder)
 
     counts = {name: 0 for name, _ in splits}
-    quotas = {
-        name: int(round(frac * num_episodes)) for name, frac in splits
-    }
-    # Rounding drift goes to the first (train) split.
-    first = splits[0][0]
-    quotas[first] += num_episodes - sum(quotas.values())
+    quotas = _split_quotas(splits, num_episodes)
     for name, _ in splits:
         os.makedirs(os.path.join(data_dir, name), exist_ok=True)
 
@@ -126,6 +124,199 @@ def collect_dataset(
                 f"collected {collected}/{num_episodes} "
                 f"({attempts} attempts)"
             )
+    write_manifest(
+        data_dir,
+        embedder=embedder,
+        reward=reward_name,
+        block_mode=block_mode.value,
+        max_steps=max_steps,
+        image_hw=image_hw,
+        episodes=num_episodes,
+        seed=seed,
+    )
+    return counts
+
+
+def _split_quotas(splits, num_episodes):
+    """Episode quota per split; rounding drift goes to the first (train)."""
+    quotas = {name: int(round(frac * num_episodes)) for name, frac in splits}
+    quotas[splits[0][0]] += num_episodes - sum(quotas.values())
+    return quotas
+
+
+def check_embedder_compatibility(
+    data_dir, embedder_spec, context="", manifest_name="manifest.json"
+):
+    """Raise if the dataset manifest records a different instruction embedder.
+
+    The embedding IS the task specification: a policy trained on data
+    embedded with one provider decodes garbage from another. No-op for
+    pre-manifest datasets. Returns the manifest (or None).
+    """
+    manifest = read_manifest(data_dir, manifest_name)
+    if manifest is None:
+        return None
+    recorded = manifest.get("embedder")
+    requested = (
+        embedder_spec
+        if isinstance(embedder_spec, str)
+        else getattr(embedder_spec, "name", None)
+    )
+    if recorded and requested and recorded != requested:
+        raise ValueError(
+            f"Embedder mismatch{' (' + context + ')' if context else ''}: "
+            f"dataset {data_dir!r} was embedded with {recorded!r} but "
+            f"{requested!r} was requested. Re-collect/convert the data or "
+            f"pass the matching embedder."
+        )
+    return manifest
+
+
+def write_manifest(data_dir, **fields):
+    """Stamp collection provenance — most importantly the instruction
+    embedder — into `<data_dir>/manifest.json`, so consumers can verify that
+    data embedded with one provider is never silently mixed with a policy
+    using another (the embedding IS the task specification). See
+    `check_embedder_compatibility` for the enforcement hook."""
+    fields = dict(fields)
+    emb = fields.get("embedder")
+    if emb is not None and not isinstance(emb, str):
+        fields["embedder"] = getattr(emb, "name", str(emb))
+    with open(os.path.join(data_dir, "manifest.json"), "w") as f:
+        json.dump(fields, f, indent=2, sort_keys=True)
+    return fields
+
+
+def read_manifest(data_dir, manifest_name="manifest.json"):
+    """Return the manifest dict, or None for pre-manifest datasets."""
+    path = os.path.join(data_dir, manifest_name)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _collect_shard(shard_dir, count, seed, kwargs):
+    """One worker: collect `count` successful episodes into `shard_dir`."""
+    from rt1_tpu.data.episodes import save_episode
+
+    env = LanguageTable(
+        block_mode=blocks.BlockMode(kwargs.get("block_mode", "BLOCK_8")),
+        reward_factory=rewards_module.get_reward_factory(
+            kwargs.get("reward_name", "block2block")
+        ),
+        seed=seed,
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=seed)
+    embed_fn = get_embedder(kwargs.get("embedder", "hash"))
+    os.makedirs(shard_dir, exist_ok=True)
+    done = 0
+    while done < count:
+        ep = collect_episode(
+            env,
+            oracle,
+            embed_fn,
+            max_steps=kwargs.get("max_steps", 80),
+            image_hw=kwargs.get("image_hw"),
+        )
+        if ep is None:
+            continue
+        save_episode(os.path.join(shard_dir, f"episode_{done}.npz"), ep)
+        done += 1
+    return done
+
+
+def collect_dataset_parallel(
+    data_dir,
+    num_episodes,
+    workers=8,
+    block_mode=blocks.BlockMode.BLOCK_8,
+    reward_name="block2block",
+    seed=0,
+    max_steps=80,
+    splits=(("train", 0.975), ("val", 0.0125), ("test", 0.0125)),
+    embedder="hash",
+    image_hw=None,
+):
+    """`collect_dataset` fanned out over `workers` processes.
+
+    Each worker runs its own env/oracle/embedder seeded at `seed + w` and
+    writes to a private shard directory; the parent then deals shards into
+    split directories round-robin (so every split mixes all worker seeds)
+    and writes the manifest. Rollout collection is embarrassingly parallel —
+    the reference leans on a pre-recorded RLDS corpus instead, so it never
+    needed this, but hermetic data generation does.
+    """
+    import multiprocessing as mp
+
+    per = [num_episodes // workers] * workers
+    for i in range(num_episodes % workers):
+        per[i] += 1
+    kwargs = dict(
+        block_mode=block_mode.value,
+        reward_name=reward_name,
+        embedder=embedder,
+        max_steps=max_steps,
+        image_hw=image_hw,
+    )
+    shard_root = os.path.join(data_dir, "_shards")
+    # A crashed prior run leaves stale shard files that os.walk would
+    # otherwise deal into the new dataset (possibly collected under
+    # different settings than this manifest records).
+    shutil.rmtree(shard_root, ignore_errors=True)
+    ctx = mp.get_context("spawn")  # fork is unsafe under JAX/TF runtimes
+    procs = []
+    for w, count in enumerate(per):
+        if count == 0:
+            continue
+        p = ctx.Process(
+            target=_collect_shard,
+            args=(os.path.join(shard_root, f"shard_{w}"), count,
+                  seed + w, kwargs),
+        )
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join()
+        if p.exitcode != 0:
+            raise RuntimeError(f"collect worker failed (exit {p.exitcode})")
+
+    all_eps = sorted(
+        os.path.join(root, f)
+        for root, _, files in os.walk(shard_root)
+        for f in files
+        if f.endswith(".npz")
+    )
+    if len(all_eps) < num_episodes:
+        raise RuntimeError(
+            f"workers produced {len(all_eps)} episodes, need {num_episodes}"
+        )
+    quotas = _split_quotas(splits, num_episodes)
+    counts = {name: 0 for name, _ in splits}
+    # Shuffle episodes across worker shards, then deal contiguous quota
+    # blocks — the shuffle is what mixes every worker seed into each split.
+    order = []
+    for name, _ in splits:
+        order.extend([name] * quotas[name])
+    rng = np.random.default_rng(seed)
+    rng.shuffle(all_eps)
+    for path, name in zip(all_eps, order):
+        dst = os.path.join(data_dir, name)
+        os.makedirs(dst, exist_ok=True)
+        shutil.move(path, os.path.join(dst, f"episode_{counts[name]}.npz"))
+        counts[name] += 1
+    shutil.rmtree(shard_root, ignore_errors=True)
+    write_manifest(
+        data_dir,
+        embedder=embedder,
+        reward=reward_name,
+        block_mode=block_mode.value,
+        max_steps=max_steps,
+        image_hw=image_hw,
+        episodes=num_episodes,
+        seed=seed,
+        workers=workers,
+    )
     return counts
 
 
@@ -134,7 +325,12 @@ def main(argv):
     from absl import flags
 
     FLAGS = flags.FLAGS
-    counts = collect_dataset(
+    collect = (
+        collect_dataset
+        if FLAGS.workers <= 1
+        else functools.partial(collect_dataset_parallel, workers=FLAGS.workers)
+    )
+    counts = collect(
         FLAGS.data_dir,
         FLAGS.episodes,
         block_mode=blocks.BlockMode(FLAGS.block_mode),
@@ -156,4 +352,5 @@ if __name__ == "__main__":
     flags.DEFINE_integer("seed", 0, "Env seed.")
     flags.DEFINE_integer("max_steps", 80, "Max steps per episode.")
     flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    flags.DEFINE_integer("workers", 1, "Parallel collection processes.")
     app.run(main)

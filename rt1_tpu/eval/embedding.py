@@ -13,10 +13,17 @@ not available in this image, so embedding is a pluggable provider:
   instruction string. Self-contained: train-time conversion and eval use the
   same mapping, so policies trained in this framework are consistent end to
   end even without USE weights.
+* `NgramInstructionEmbedder` — feature-hashed bag of word n-grams. Unlike the
+  per-string hash, this is COMPOSITIONAL: instructions sharing words ("red
+  moon", "blue cube") share feature vectors, so a policy generalizes to
+  phrasings never seen in training — the property USE provides in the
+  reference (`rlds_np_convert.py:48`) and the one that matters for
+  closed-loop eval, where the grammar samples from thousands of strings.
 * `UniversalSentenceEncoder` — the real TF-hub model, import-gated.
 """
 
 import hashlib
+import re
 
 import numpy as np
 
@@ -40,6 +47,53 @@ class HashInstructionEmbedder:
             rng = np.random.RandomState(seed)
             vec = rng.randn(self.dim).astype(np.float32)
             vec /= np.linalg.norm(vec)
+            self._cache[text] = vec
+        return vec
+
+
+class NgramInstructionEmbedder:
+    """Feature-hashed word n-gram embedding (a classical HashingVectorizer
+    composed with a fixed Gaussian random projection).
+
+    Each word n-gram (n = 1..max_n) is hashed to a deterministic unit
+    Gaussian in R^dim; the instruction embedding is the normalized sum.
+    Unigrams carry content words, bigrams/trigrams carry enough order to
+    separate "push the red moon to the blue cube" from its reverse
+    ("moon_to" vs "cube_to", "to_the_blue" vs "to_the_red").
+    """
+
+    name = "ngram"
+
+    def __init__(self, dim=EMBEDDING_DIM, max_n=3):
+        self.dim = dim
+        self.max_n = max_n
+        self._feature_cache = {}
+        self._cache = {}
+
+    def _feature_vec(self, feat):
+        vec = self._feature_cache.get(feat)
+        if vec is None:
+            digest = hashlib.sha256(feat.encode("utf-8")).digest()
+            seed = int.from_bytes(digest[:8], "little") % (2**32)
+            rng = np.random.RandomState(seed)
+            vec = rng.randn(self.dim).astype(np.float32)
+            vec /= np.linalg.norm(vec)
+            self._feature_cache[feat] = vec
+        return vec
+
+    def __call__(self, text):
+        vec = self._cache.get(text)
+        if vec is None:
+            words = re.findall(r"[a-z0-9]+", text.lower())
+            feats = [
+                "_".join(words[i : i + n])
+                for n in range(1, self.max_n + 1)
+                for i in range(len(words) - n + 1)
+            ]
+            if not feats:
+                feats = ["<empty>"]
+            vec = np.sum([self._feature_vec(f) for f in feats], axis=0)
+            vec = (vec / np.linalg.norm(vec)).astype(np.float32)
             self._cache[text] = vec
         return vec
 
@@ -109,6 +163,8 @@ def get_embedder(spec="hash"):
         return spec
     if spec == "hash":
         return HashInstructionEmbedder()
+    if spec == "ngram":
+        return NgramInstructionEmbedder()
     if spec == "use":
         return UniversalSentenceEncoder()
     if spec.endswith(".npz"):

@@ -64,6 +64,19 @@ def main(argv):
 
     FLAGS = flags.FLAGS
     config = FLAGS.config
+    if not FLAGS.allow_embedder_mismatch:
+        # The train CLI stamped the training data's embedder next to the
+        # checkpoints; evaluating with a different provider would feed the
+        # policy embeddings from a foreign domain and silently score ~random.
+        from rt1_tpu.data.collect import check_embedder_compatibility
+
+        check_embedder_compatibility(
+            FLAGS.workdir,
+            FLAGS.embedder,
+            context="checkpoint data_manifest; pass "
+            "--allow_embedder_mismatch to override",
+            manifest_name="data_manifest.json",
+        )
     policy, step = load_policy_from_workdir(config, FLAGS.workdir)
     results = evaluate_policy(
         policy,
@@ -99,5 +112,9 @@ if __name__ == "__main__":
     flags.DEFINE_integer("seed", 0, "Env seed.")
     flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
     flags.DEFINE_bool("videos", False, "Write episode videos.")
+    flags.DEFINE_bool(
+        "allow_embedder_mismatch", False,
+        "Evaluate even if the checkpoint's data manifest records a "
+        "different instruction embedder.")
     flags.mark_flags_as_required(["config"])
     app.run(main)

@@ -1,0 +1,208 @@
+"""In-graph CLIP text tower for the LAVA "clip" language encoder.
+
+Parity source: the reference pulls scenic's frozen CLIP-B/16 text encoder into
+LAVA (`language_table/train/networks/lava.py:29,425-435`) and freezes it via
+the optimizer (`language_table/train/bc.py:94-140`). This is the same
+architecture (OpenAI CLIP text transformer: token embedding + learned
+positional embedding, pre-LN causal transformer with QuickGELU MLPs, final
+LayerNorm, EOT-token pooling, linear text projection) written as a Flax
+module whose parameter tree mirrors the public CLIP checkpoint layout, so
+`convert_clip_text_state_dict` can load real OpenAI weights when a checkpoint
+is available and `make_bc_optimizer(frozen_prefixes=...)` can freeze it.
+
+Token input comes from `rt1_tpu.text.clip_bpe.ClipTokenizer` (77-token
+framing with SOT/EOT), under the observation key the reference uses:
+`instruction_tokenized_clip`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+# OpenAI CLIP text-encoder constants (ViT-B checkpoints).
+VOCAB_SIZE = 49408
+CONTEXT_LENGTH = 77
+WIDTH = 512
+NUM_LAYERS = 12
+NUM_HEADS = 8
+EMBED_DIM = 512
+
+# The param-tree prefix to freeze when the tower is used inside
+# SequenceLAVAEncoder (make_bc_optimizer(frozen_prefixes=...)).
+FROZEN_PREFIX = "encoder/text_encoder"
+
+
+def quick_gelu(x):
+    """CLIP's GELU approximation: x * sigmoid(1.702 x)."""
+    return x * nn.sigmoid(1.702 * x)
+
+
+class ResidualAttentionBlock(nn.Module):
+    """Pre-LN block: LN -> causal MHA -> +res; LN -> QuickGELU MLP -> +res."""
+
+    width: int
+    num_heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask):
+        y = nn.LayerNorm(epsilon=1e-5, name="ln_1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            deterministic=True,
+            name="attn",
+        )(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(epsilon=1e-5, name="ln_2")(x)
+        y = nn.Dense(4 * self.width, dtype=self.dtype, name="c_fc")(y)
+        y = quick_gelu(y)
+        y = nn.Dense(self.width, dtype=self.dtype, name="c_proj")(y)
+        return x + y
+
+
+class CLIPTextEncoder(nn.Module):
+    """tokens (B, context) int32 -> pooled text features (B, embed_dim).
+
+    Pooling takes the sequence position of the highest token id — the EOT
+    token (id vocab_size-1) in CLIP's BPE framing — then applies the linear
+    text projection, exactly like the OpenAI / scenic implementations.
+    """
+
+    vocab_size: int = VOCAB_SIZE
+    context_length: int = CONTEXT_LENGTH
+    width: int = WIDTH
+    num_layers: int = NUM_LAYERS
+    num_heads: int = NUM_HEADS
+    embed_dim: int = EMBED_DIM
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        if tokens.ndim != 2:
+            raise ValueError(f"tokens must be (batch, context), got {tokens.shape}")
+        x = nn.Embed(
+            self.vocab_size, self.width, dtype=self.dtype,
+            name="token_embedding",
+        )(tokens)
+        posemb = self.param(
+            "positional_embedding",
+            nn.initializers.normal(stddev=0.01),
+            (self.context_length, self.width),
+        )
+        x = x + posemb[: x.shape[1]].astype(self.dtype)
+
+        # Static causal mask — no padding mask: CLIP attends causally over
+        # the full 77-token frame; the EOT pooling ignores the padded tail.
+        mask = nn.make_causal_mask(tokens)
+        for i in range(self.num_layers):
+            x = ResidualAttentionBlock(
+                width=self.width,
+                num_heads=self.num_heads,
+                dtype=self.dtype,
+                name=f"resblocks_{i}",
+            )(x, mask)
+
+        x = nn.LayerNorm(epsilon=1e-5, name="ln_final")(x)
+        eot = jnp.argmax(tokens, axis=-1)
+        pooled = jnp.take_along_axis(x, eot[:, None, None], axis=1)[:, 0]
+        projection = self.param(
+            "text_projection",
+            nn.initializers.normal(stddev=self.width ** -0.5),
+            (self.width, self.embed_dim),
+        )
+        return pooled @ projection.astype(self.dtype)
+
+
+def convert_clip_text_state_dict(
+    state_dict: Dict[str, np.ndarray],
+    num_heads: int = NUM_HEADS,
+) -> Dict[str, Any]:
+    """Public OpenAI-CLIP torch state dict (text side) -> this module's params.
+
+    Expected torch keys (possibly under a leading "transformer." scope for
+    the text transformer blocks):
+      token_embedding.weight, positional_embedding,
+      transformer.resblocks.N.ln_1.{weight,bias},
+      transformer.resblocks.N.attn.{in_proj_weight,in_proj_bias},
+      transformer.resblocks.N.attn.out_proj.{weight,bias},
+      transformer.resblocks.N.mlp.c_fc.{weight,bias},
+      transformer.resblocks.N.mlp.c_proj.{weight,bias},
+      ln_final.{weight,bias}, text_projection
+
+    The packed qkv `in_proj_weight` (3W, W) is split and reshaped to flax
+    MultiHeadDotProductAttention's (W, heads, head_dim) kernels.
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    width = sd["token_embedding.weight"].shape[1]
+    head_dim = width // num_heads
+
+    params: Dict[str, Any] = {
+        "token_embedding": {"embedding": sd["token_embedding.weight"]},
+        "positional_embedding": sd["positional_embedding"],
+        "ln_final": {
+            "scale": sd["ln_final.weight"],
+            "bias": sd["ln_final.bias"],
+        },
+        "text_projection": sd["text_projection"],
+    }
+
+    n_layers = 0
+    while f"transformer.resblocks.{n_layers}.ln_1.weight" in sd:
+        n_layers += 1
+    if n_layers == 0:
+        raise KeyError("No transformer.resblocks.* keys in state dict")
+
+    for i in range(n_layers):
+        p = f"transformer.resblocks.{i}"
+        in_w = sd[f"{p}.attn.in_proj_weight"]  # (3W, W), rows are out dims
+        in_b = sd[f"{p}.attn.in_proj_bias"]  # (3W,)
+        out_w = sd[f"{p}.attn.out_proj.weight"]  # (W, W)
+        qw, kw, vw = np.split(in_w, 3, axis=0)
+        qb, kb, vb = np.split(in_b, 3, axis=0)
+
+        def head_kernel(w):
+            # torch Linear stores (out, in); flax wants (in, heads, head_dim).
+            return w.T.reshape(width, num_heads, head_dim)
+
+        params[f"resblocks_{i}"] = {
+            "ln_1": {
+                "scale": sd[f"{p}.ln_1.weight"],
+                "bias": sd[f"{p}.ln_1.bias"],
+            },
+            "ln_2": {
+                "scale": sd[f"{p}.ln_2.weight"],
+                "bias": sd[f"{p}.ln_2.bias"],
+            },
+            "attn": {
+                "query": {
+                    "kernel": head_kernel(qw),
+                    "bias": qb.reshape(num_heads, head_dim),
+                },
+                "key": {
+                    "kernel": head_kernel(kw),
+                    "bias": kb.reshape(num_heads, head_dim),
+                },
+                "value": {
+                    "kernel": head_kernel(vw),
+                    "bias": vb.reshape(num_heads, head_dim),
+                },
+                "out": {
+                    "kernel": out_w.T.reshape(num_heads, head_dim, width),
+                    "bias": sd[f"{p}.attn.out_proj.bias"],
+                },
+            },
+            "c_fc": {
+                "kernel": sd[f"{p}.mlp.c_fc.weight"].T,
+                "bias": sd[f"{p}.mlp.c_fc.bias"],
+            },
+            "c_proj": {
+                "kernel": sd[f"{p}.mlp.c_proj.weight"].T,
+                "bias": sd[f"{p}.mlp.c_proj.bias"],
+            },
+        }
+    return params

@@ -5,9 +5,13 @@ Language encoders supported:
   * "embedding_in_obs" — a precomputed language embedding is provided in the
     observation under `lang_key` (covers the reference's "clip_in_obs", and
     our USE/hash-embedding path).
-  * "clip" — an in-graph frozen CLIP text tower. The reference pulls this
-    from scenic (`lava.py:29,425-435`), which is not vendored here; selecting
-    it raises with instructions to plug a tower in via `text_encoder_def`.
+  * "clip" — an in-graph CLIP text tower consuming `instruction_tokenized_clip`
+    BPE tokens. Defaults to `clip_text.CLIPTextEncoder` (the architecture the
+    reference pulls from scenic, `lava.py:29,425-435`); override with any
+    module via `text_encoder_def`. Freeze it with
+    `make_bc_optimizer(frozen_prefixes=(clip_text.FROZEN_PREFIX,))` and load
+    public OpenAI weights via `clip_text.convert_clip_text_state_dict` +
+    `remap_pretrained_params`.
 """
 
 from typing import Any, Optional, Sequence, Tuple
@@ -135,15 +139,27 @@ class SequenceLAVAEncoder(nn.Module):
         if self.lang_encoder == "embedding_in_obs":
             lang = obs[self.lang_key].reshape(bs * seqlen, -1)
         elif self.lang_encoder == "clip":
+            from rt1_tpu.models.lava.clip_text import CLIPTextEncoder
+
+            # Stable name "text_encoder" so the freeze prefix
+            # (clip_text.FROZEN_PREFIX) and pretrained remap targets don't
+            # depend on flax auto-numbering. Re-construct inline (clone()
+            # would stay unbound inside compact) with the same fields.
             if self.text_encoder_def is None:
-                raise NotImplementedError(
-                    "In-graph CLIP text tower requires text_encoder_def "
-                    "(the reference pulls scenic's frozen CLIP, lava.py:29); "
-                    "use lang_encoder='embedding_in_obs' with precomputed "
-                    "embeddings instead."
+                tower = CLIPTextEncoder(name="text_encoder")
+            else:
+                import dataclasses
+
+                fields = {
+                    f.name: getattr(self.text_encoder_def, f.name)
+                    for f in dataclasses.fields(self.text_encoder_def)
+                    if f.name not in ("parent", "name")
+                }
+                tower = type(self.text_encoder_def)(
+                    **fields, name="text_encoder"
                 )
             tokens = obs["instruction_tokenized_clip"].astype(jnp.int32)[:, 0]
-            lang = self.text_encoder_def(tokens)
+            lang = tower(tokens)
             lang = jnp.tile(lang[:, None, :], [1, seqlen, 1]).reshape(
                 bs * seqlen, -1
             )

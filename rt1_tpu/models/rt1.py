@@ -183,10 +183,17 @@ class RT1Policy(nn.Module):
         if train and self.photometric_augmentation:
             # On-device color jitter (Stack B's PhotometricDistortions,
             # `input_pipeline_rlds.py:391-457`), fused into the forward so
-            # the host pipeline stays augmentation-free.
+            # the host pipeline stays augmentation-free. Dedicated "augment"
+            # stream so color randomness is independent of the crop offsets
+            # ("crop" fallback keeps old callers working).
             from rt1_tpu.ops.augment import photometric_distortions
 
-            image = photometric_distortions(image, self.make_rng("crop"))
+            aug_rng = (
+                self.make_rng("augment")
+                if self.has_rng("augment")
+                else self.make_rng("crop")
+            )
+            image = photometric_distortions(image, aug_rng)
         return image
 
     def _tokenize_images(

@@ -14,6 +14,7 @@ def get_config():
 
     # Model (SURVEY.md §2.1 instantiation).
     config.model = ml_collections.ConfigDict()
+    config.model.family = "rt1"  # "rt1" | "lava" (Stack A vs Stack B)
     config.model.vocab_size = 256
     config.model.token_embedding_size = 512
     config.model.num_layers = 8
@@ -27,6 +28,21 @@ def get_config():
     config.model.image_tokenizer = "efficientnet_b3"
     config.model.dtype = "bfloat16"
     config.model.photometric_augmentation = False
+
+    # LAVA family fields (used when family == "lava"; defaults mirror the
+    # reference's SequenceLAVMSE config, `train/configs/
+    # language_table_sim_local.py:27-49`).
+    config.model.lava = ml_collections.ConfigDict()
+    config.model.lava.action_size = 2
+    config.model.lava.d_model = 128
+    config.model.lava.num_layers = 2
+    config.model.lava.temporal_num_layers = 2
+    config.model.lava.num_heads = 2
+    config.model.lava.pyramid_fuse_layers = (2, 3, 4)
+    config.model.lava.image_encoder = "conv_maxpool"
+    config.model.lava.lang_encoder = "embedding_in_obs"
+    config.model.lava.dense_resnet_width = 256
+    config.model.lava.dense_resnet_num_blocks = 8
 
     # Data.
     config.data = ml_collections.ConfigDict()
@@ -63,7 +79,9 @@ def get_config():
     # Checkpoint / logging cadence.
     config.checkpoint_every_steps = 975
     config.keep_period = 9750
-    config.max_to_keep = 0  # 0 -> keep all (reference save_top_k=-1)
+    # None -> keep all checkpoints (reference save_top_k=-1). Set an int to
+    # bound retention; keep_period still pins every Nth step.
+    config.max_to_keep = ml_collections.config_dict.placeholder(int)
     config.log_every_steps = 50
     config.eval_every_steps = 975
     config.eval_batches = 6

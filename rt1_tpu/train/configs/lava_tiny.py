@@ -1,0 +1,26 @@
+"""CPU smoke config for the LAVA family: tiny dims + synthetic data.
+
+The reference trains LAVA from Stack B (`language_table/train/train.py:60-218`
+with `configs/language_table_sim_local.py`); this config drives the same model
+family through the unified train CLI:
+
+  python -m rt1_tpu.train.train --config rt1_tpu/train/configs/lava_tiny.py \
+      --workdir /tmp/lava
+"""
+
+from rt1_tpu.train.configs import tiny
+
+sweep = tiny.sweep
+
+
+def get_config():
+    config = tiny.get_config()
+    config.model.family = "lava"
+    config.model.lava.d_model = 16
+    config.model.lava.dense_resnet_width = 32
+    config.model.lava.dense_resnet_num_blocks = 1
+    config.model.lava.num_heads = 2
+    # 64x64 divides cleanly through the 5-level conv-maxpool pyramid.
+    config.data.height = 64
+    config.data.width = 64
+    return config

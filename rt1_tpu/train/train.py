@@ -71,6 +71,46 @@ def build_model(model_config):
     )
 
 
+def build_family(model_config):
+    """(model, init_fn, loss_fn) for config.model.family = "rt1" | "lava".
+
+    The reference trains its two model families from separate stacks
+    (Stack A `distribute_train.py` for RT-1, Stack B
+    `language_table/train/train.py:105-116` for LAVA/BC); here one train
+    loop serves both — the family only selects the model constructor, the
+    init signature, and the loss closure plugged into the jitted SPMD step.
+    """
+    family = model_config.get("family", "rt1")
+    if family == "rt1":
+        return build_model(model_config), None, None
+    if family == "lava":
+        from rt1_tpu.models.lava import SequenceLAVMSE
+        from rt1_tpu.trainer.bc import adapt_obs_for_lava, make_bc_step_loss_fn
+
+        lv = model_config.lava
+        model = SequenceLAVMSE(
+            action_size=lv.action_size,
+            dense_resnet_width=lv.dense_resnet_width,
+            dense_resnet_num_blocks=lv.dense_resnet_num_blocks,
+            lava_num_layers=lv.num_layers,
+            lava_sequence_length=model_config.time_sequence_length,
+            lava_temporal_transformer_num_layers=lv.temporal_num_layers,
+            lava_d_model=lv.d_model,
+            lava_num_heads=lv.num_heads,
+            lava_pyramid_fuse_layers=tuple(lv.pyramid_fuse_layers),
+            lava_image_encoder=lv.image_encoder,
+            lava_lang_encoder=lv.lang_encoder,
+        )
+
+        def init_fn(model, rng, obs, actions):
+            return model.init(
+                {"params": rng}, adapt_obs_for_lava(obs), train=False
+            )
+
+        return model, init_fn, make_bc_step_loss_fn(model)
+    raise ValueError(f"Unknown model family: {family!r}")
+
+
 def synthetic_batches(config, seed=0) -> Iterator:
     """Random fixed batches when no dataset is configured (smoke/bench)."""
     rng = np.random.default_rng(seed)
@@ -165,7 +205,7 @@ def train_and_evaluate(config, workdir: str):
     writer = create_writer(workdir)
     write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
 
-    model = build_model(config.model)
+    model, init_fn, loss_fn = build_family(config.model)
     mesh = make_mesh(
         MeshConfig(
             data=config.mesh.data,
@@ -182,6 +222,18 @@ def train_and_evaluate(config, workdir: str):
 
     if config.data.data_dir:
         train_iter = dataset_batches(config, "train")
+        # Stamp the dataset's provenance (instruction embedder, env config)
+        # next to the checkpoints, so eval can refuse a policy/embedder
+        # mismatch (the embedding is the task specification).
+        from rt1_tpu.data.collect import read_manifest
+
+        manifest = read_manifest(config.data.data_dir)
+        if manifest is not None and jax.process_index() == 0:
+            import json
+
+            os.makedirs(workdir, exist_ok=True)
+            with open(os.path.join(workdir, "data_manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=2, sort_keys=True)
     else:
         train_iter = synthetic_batches(config, config.seed)
 
@@ -196,7 +248,7 @@ def train_and_evaluate(config, workdir: str):
         grad_clip_norm=config.grad_clip_norm or None,
     )
     rng = jax.random.PRNGKey(config.seed)
-    state = create_train_state(model, rng, example, tx)
+    state = create_train_state(model, rng, example, tx, init_fn=init_fn)
     if jax.process_index() == 0:
         log_parameter_overview(
             state.params, os.path.join(workdir, "parameters.txt")
@@ -205,6 +257,8 @@ def train_and_evaluate(config, workdir: str):
     ckpt = CheckpointManager(
         CheckpointConfig(
             directory=os.path.join(os.path.abspath(workdir), "checkpoints"),
+            # `or None` coerces legacy 0-means-keep-all configs; the config
+            # itself now uses a placeholder (None = keep all) explicitly.
             max_to_keep=config.max_to_keep or None,
             save_interval_steps=config.checkpoint_every_steps,
             keep_period=config.keep_period,
@@ -213,7 +267,7 @@ def train_and_evaluate(config, workdir: str):
     state, initial_step = ckpt.restore_or_initialize(state)
 
     fns = make_train_step_fns(
-        model, mesh, state, accum_steps=config.accum_steps
+        model, mesh, state, accum_steps=config.accum_steps, loss_fn=loss_fn
     )
     state = fns.shard_state(state)
 

@@ -114,6 +114,47 @@ def remap_pretrained_params(
     return flax.traverse_util.unflatten_dict(out)
 
 
+def adapt_obs_for_lava(obs: Dict[str, Any]) -> Dict[str, Any]:
+    """Windowed-pipeline observation keys -> LAVA's (`image` -> `rgb`)."""
+    lava_obs = dict(obs)
+    if "rgb" not in lava_obs and "image" in lava_obs:
+        lava_obs["rgb"] = lava_obs.pop("image")
+    return lava_obs
+
+
+def make_bc_step_loss_fn(model: Any) -> Callable:
+    """LAVA/BC loss in the unified SPMD-step signature.
+
+    Plugs a LAVA-family model into `make_train_step_fns(loss_fn=...)` — the
+    equivalent of the reference Stack B training LAVA end to end
+    (`language_table/train/train.py:105-116`). Adapts the windowed pipeline's
+    observation keys (`image` -> `rgb`) and takes the LAST frame's action as
+    the BC target (LAVA predicts one action per window).
+    """
+
+    def loss_fn(params, batch_stats, batch, rng, train):
+        obs, actions = batch
+        lava_obs = adapt_obs_for_lava(obs)
+        target = actions["action"] if isinstance(actions, dict) else actions
+        if target.ndim == 3:
+            target = target[:, -1]
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        predicted = model.apply(
+            variables,
+            lava_obs,
+            train=train,
+            rngs={"dropout": rng} if train else {},
+        )
+        loss = bc_mse_loss(predicted, target)
+        # The frozen resnet tower never updates batch_stats (always applied
+        # with use_running_average), so stats pass through unchanged.
+        return loss, ({"loss": loss}, batch_stats)
+
+    return loss_fn
+
+
 def make_bc_loss_fn(
     model: Any,
     batch_stats: Optional[Any] = None,

@@ -53,7 +53,11 @@ def _loss_fn(model, params, batch_stats, batch: Batch, rng: jax.Array, train: bo
     variables = {"params": params}
     if batch_stats:
         variables["batch_stats"] = batch_stats
-    rngs = {"crop": jax.random.fold_in(rng, 0), "dropout": jax.random.fold_in(rng, 1)}
+    rngs = {
+        "crop": jax.random.fold_in(rng, 0),
+        "dropout": jax.random.fold_in(rng, 1),
+        "augment": jax.random.fold_in(rng, 2),
+    }
     if train and batch_stats:
         out, mutated = model.apply(
             variables, obs, actions, train=True, rngs=rngs, mutable=["batch_stats"]
@@ -71,21 +75,31 @@ def make_train_step_fns(
     accum_steps: int = 1,
     batch_axes: Tuple[str, ...] = ("data",),
     donate: bool = True,
+    loss_fn: Optional[Callable] = None,
 ) -> TrainStepFns:
     """Build jitted train/eval steps with explicit in/out shardings.
 
     `state` is only used to derive the sharding pytree (its structure, not its
     values); call `fns.shard_state(state)` afterwards to place it on the mesh.
+
+    `loss_fn(params, batch_stats, batch, rng, train) -> (loss, (out, new_bs))`
+    overrides the default RT-1 token-CE closure — the hook that lets the same
+    SPMD step machinery train other model families (LAVA BC MSE via
+    `trainer.bc.make_bc_step_loss_fn`, reference Stack B `train.py:105-116`).
+    `out` must contain "loss"; extra keys become metrics where recognized.
     """
     if param_rules is None:
         param_rules = shardlib.rt1_parameter_rules()
+    if loss_fn is None:
+        def loss_fn(params, batch_stats, batch, rng, train):
+            return _loss_fn(model, params, batch_stats, batch, rng, train)
     state_sharding = shardlib.shard_pytree(state, mesh, param_rules)
     batch_sh = NamedSharding(mesh, P(batch_axes))
     repl = NamedSharding(mesh, P())
 
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         grad_fn = jax.value_and_grad(
-            lambda p, bs, b, r: _loss_fn(model, p, bs, b, r, train=True), has_aux=True
+            lambda p, bs, b, r: loss_fn(p, bs, b, r, train=True), has_aux=True
         )
 
         if accum_steps == 1:
@@ -132,16 +146,17 @@ def make_train_step_fns(
         return new_state, metrics
 
     def eval_step(state: TrainState, batch: Batch):
-        loss, (out, _) = _loss_fn(
-            model, state.params, state.batch_stats, batch, jax.random.PRNGKey(0), train=False
+        loss, (out, _) = loss_fn(
+            state.params, state.batch_stats, batch, jax.random.PRNGKey(0), train=False
         )
-        obs, actions = batch
-        labels = out["action_labels"]
-        preds = out["action_predictions"]
-        return {
-            "loss": loss,
-            "token_accuracy": jnp.mean((preds == labels).astype(jnp.float32)),
-        }
+        metrics = {"loss": loss}
+        if "action_labels" in out and "action_predictions" in out:
+            labels = out["action_labels"]
+            preds = out["action_predictions"]
+            metrics["token_accuracy"] = jnp.mean(
+                (preds == labels).astype(jnp.float32)
+            )
+        return metrics
 
     with mesh:
         train_jit = jax.jit(

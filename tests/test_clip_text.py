@@ -1,0 +1,257 @@
+"""CLIP text tower: semantics vs a numpy reference, porting, LAVA wiring.
+
+Mirrors the role of the reference's frozen-scenic-CLIP integration
+(`language_table/train/networks/lava.py:425-435`, `train/bc.py:94-140`):
+the tower must (a) compute the OpenAI CLIP text forward exactly — proved
+against an independent numpy implementation driven by a torch-layout state
+dict — (b) load public-checkpoint weights through the converter, and
+(c) train frozen inside SequenceLAVMSE.
+"""
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from rt1_tpu.models.lava import SequenceLAVMSE
+from rt1_tpu.models.lava.clip_text import (
+    CLIPTextEncoder,
+    convert_clip_text_state_dict,
+)
+from rt1_tpu.trainer.bc import (
+    make_bc_loss_fn,
+    make_bc_optimizer,
+    remap_pretrained_params,
+)
+
+VOCAB, CTX, WIDTH, LAYERS, HEADS, EMBED = 50, 10, 16, 2, 2, 12
+
+
+def tiny_tower(**kw):
+    return CLIPTextEncoder(
+        vocab_size=VOCAB,
+        context_length=CTX,
+        width=WIDTH,
+        num_layers=LAYERS,
+        num_heads=HEADS,
+        embed_dim=EMBED,
+        **kw,
+    )
+
+
+def clip_frame(rng, batch, body_len):
+    """CLIP-style token frames: SOT, body, EOT(=vocab-1), zero padding."""
+    tokens = np.zeros((batch, CTX), np.int32)
+    tokens[:, 0] = VOCAB - 2  # SOT
+    body = rng.integers(1, VOCAB - 2, (batch, body_len))
+    tokens[:, 1 : 1 + body_len] = body
+    tokens[:, 1 + body_len] = VOCAB - 1  # EOT
+    return tokens
+
+
+def random_torch_state_dict(rng):
+    """A synthetic state dict in the public CLIP torch key layout."""
+    sd = {
+        "token_embedding.weight": rng.standard_normal((VOCAB, WIDTH)),
+        "positional_embedding": rng.standard_normal((CTX, WIDTH)),
+        "ln_final.weight": rng.standard_normal(WIDTH) * 0.1 + 1,
+        "ln_final.bias": rng.standard_normal(WIDTH) * 0.1,
+        "text_projection": rng.standard_normal((WIDTH, EMBED)),
+    }
+    for i in range(LAYERS):
+        p = f"transformer.resblocks.{i}"
+        sd[f"{p}.ln_1.weight"] = rng.standard_normal(WIDTH) * 0.1 + 1
+        sd[f"{p}.ln_1.bias"] = rng.standard_normal(WIDTH) * 0.1
+        sd[f"{p}.ln_2.weight"] = rng.standard_normal(WIDTH) * 0.1 + 1
+        sd[f"{p}.ln_2.bias"] = rng.standard_normal(WIDTH) * 0.1
+        sd[f"{p}.attn.in_proj_weight"] = rng.standard_normal(
+            (3 * WIDTH, WIDTH)
+        ) / np.sqrt(WIDTH)
+        sd[f"{p}.attn.in_proj_bias"] = rng.standard_normal(3 * WIDTH) * 0.1
+        sd[f"{p}.attn.out_proj.weight"] = rng.standard_normal(
+            (WIDTH, WIDTH)
+        ) / np.sqrt(WIDTH)
+        sd[f"{p}.attn.out_proj.bias"] = rng.standard_normal(WIDTH) * 0.1
+        sd[f"{p}.mlp.c_fc.weight"] = rng.standard_normal(
+            (4 * WIDTH, WIDTH)
+        ) / np.sqrt(WIDTH)
+        sd[f"{p}.mlp.c_fc.bias"] = rng.standard_normal(4 * WIDTH) * 0.1
+        sd[f"{p}.mlp.c_proj.weight"] = rng.standard_normal(
+            (WIDTH, 4 * WIDTH)
+        ) / np.sqrt(4 * WIDTH)
+        sd[f"{p}.mlp.c_proj.bias"] = rng.standard_normal(WIDTH) * 0.1
+    return {k: v.astype(np.float32) for k, v in sd.items()}
+
+
+def numpy_clip_text(sd, tokens, num_heads):
+    """Independent numpy CLIP text forward from the torch-layout arrays."""
+
+    def ln(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    width = sd["token_embedding.weight"].shape[1]
+    hd = width // num_heads
+    b, t = tokens.shape
+    x = sd["token_embedding.weight"][tokens] + sd["positional_embedding"][:t]
+    causal = np.tril(np.ones((t, t), bool))
+    i = 0
+    while f"transformer.resblocks.{i}.ln_1.weight" in sd:
+        p = f"transformer.resblocks.{i}"
+        y = ln(x, sd[f"{p}.ln_1.weight"], sd[f"{p}.ln_1.bias"])
+        qkv = y @ sd[f"{p}.attn.in_proj_weight"].T + sd[f"{p}.attn.in_proj_bias"]
+        q, k, v = np.split(qkv, 3, axis=-1)
+        heads_out = []
+        for h in range(num_heads):
+            qs = q[..., h * hd : (h + 1) * hd]
+            ks = k[..., h * hd : (h + 1) * hd]
+            vs = v[..., h * hd : (h + 1) * hd]
+            logits = qs @ ks.transpose(0, 2, 1) / np.sqrt(hd)
+            logits = np.where(causal, logits, -1e30)
+            w = np.exp(logits - logits.max(-1, keepdims=True))
+            w = w / w.sum(-1, keepdims=True)
+            heads_out.append(w @ vs)
+        attn = np.concatenate(heads_out, axis=-1)
+        attn = attn @ sd[f"{p}.attn.out_proj.weight"].T + sd[f"{p}.attn.out_proj.bias"]
+        x = x + attn
+        y = ln(x, sd[f"{p}.ln_2.weight"], sd[f"{p}.ln_2.bias"])
+        y = y @ sd[f"{p}.mlp.c_fc.weight"].T + sd[f"{p}.mlp.c_fc.bias"]
+        y = y * (1 / (1 + np.exp(-1.702 * y)))  # QuickGELU
+        y = y @ sd[f"{p}.mlp.c_proj.weight"].T + sd[f"{p}.mlp.c_proj.bias"]
+        x = x + y
+        i += 1
+    x = ln(x, sd["ln_final.weight"], sd["ln_final.bias"])
+    pooled = x[np.arange(b), tokens.argmax(-1)]
+    return pooled @ sd["text_projection"]
+
+
+def test_forward_shape_and_determinism():
+    tower = tiny_tower()
+    tokens = jnp.asarray(clip_frame(np.random.default_rng(0), 3, 4))
+    params = tower.init(jax.random.PRNGKey(0), tokens)
+    out1 = tower.apply(params, tokens)
+    out2 = tower.apply(params, tokens)
+    assert out1.shape == (3, EMBED)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_converted_params_match_numpy_reference():
+    """The golden check: flax(converted torch weights) == numpy(torch weights)."""
+    rng = np.random.default_rng(1)
+    sd = random_torch_state_dict(rng)
+    tokens_np = clip_frame(rng, 4, 5)
+
+    tower = tiny_tower()
+    init = tower.init(jax.random.PRNGKey(0), jnp.asarray(tokens_np))
+    converted = {"params": convert_clip_text_state_dict(sd, num_heads=HEADS)}
+    # Same tree structure and shapes as a fresh init.
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a.shape, b.shape),
+        init["params"],
+        converted["params"],
+    )
+    got = np.asarray(tower.apply(converted, jnp.asarray(tokens_np)))
+    want = numpy_clip_text(sd, tokens_np, HEADS)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_eot_pooling_ignores_suffix():
+    """Positions after EOT cannot influence the pooled output (causal mask +
+    argmax pooling) as long as they keep smaller token ids."""
+    tower = tiny_tower()
+    rng = np.random.default_rng(2)
+    tokens = clip_frame(rng, 2, 3)
+    params = tower.init(jax.random.PRNGKey(0), jnp.asarray(tokens))
+    base = np.asarray(tower.apply(params, jnp.asarray(tokens)))
+    mutated = tokens.copy()
+    mutated[:, 6:] = rng.integers(1, VOCAB - 2, mutated[:, 6:].shape)
+    out = np.asarray(tower.apply(params, jnp.asarray(mutated)))
+    np.testing.assert_allclose(base, out, rtol=1e-5, atol=1e-6)
+
+
+def _lava_clip_model():
+    return SequenceLAVMSE(
+        action_size=2,
+        dense_resnet_width=32,
+        dense_resnet_num_blocks=1,
+        lava_d_model=16,
+        lava_sequence_length=2,
+        lava_pyramid_fuse_layers=(2, 3, 4),
+        lava_image_encoder="conv_maxpool",
+        lava_lang_encoder="clip",
+        text_encoder_def=tiny_tower(),
+    )
+
+
+def _lava_obs(rng):
+    b, t = 2, 2
+    tokens = clip_frame(np.random.default_rng(3), b, 4)
+    return {
+        "rgb": jax.random.uniform(rng, (b, t, 64, 64, 3)),
+        "instruction_tokenized_clip": jnp.asarray(
+            np.tile(tokens[:, None, :], (1, t, 1))
+        ),
+    }
+
+
+def test_lava_clip_trains_with_frozen_tower():
+    model = _lava_clip_model()
+    rng = jax.random.PRNGKey(0)
+    obs = _lava_obs(rng)
+    variables = model.init({"params": rng}, obs, train=False)
+    params = variables["params"]
+    assert "text_encoder" in params["encoder"], sorted(params["encoder"])
+
+    tx = make_bc_optimizer(
+        learning_rate=1e-2, frozen_prefixes=("encoder/text_encoder",)
+    )
+    opt_state = tx.init(params)
+    loss_fn = make_bc_loss_fn(model)
+    target = jnp.asarray(np.random.default_rng(4).uniform(-1, 1, (2, 2)),
+                         jnp.float32)
+    grads = jax.grad(lambda p: loss_fn(p, (obs, target),
+                                       jax.random.PRNGKey(1))[0])(params)
+    updates, _ = tx.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+
+    frozen_before = params["encoder"]["text_encoder"]
+    frozen_after = new_params["encoder"]["text_encoder"]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        frozen_before,
+        frozen_after,
+    )
+    # And something else did move.
+    moved = jax.tree.leaves(
+        jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            params["dense_resnet"],
+            new_params["dense_resnet"],
+        )
+    )
+    assert max(moved) > 0
+
+
+def test_pretrained_remap_into_lava():
+    """convert -> remap_pretrained_params lands real-layout weights in-tree."""
+    model = _lava_clip_model()
+    rng = jax.random.PRNGKey(0)
+    obs = _lava_obs(rng)
+    params = model.init({"params": rng}, obs, train=False)["params"]
+    sd = random_torch_state_dict(np.random.default_rng(5))
+    converted = convert_clip_text_state_dict(sd, num_heads=HEADS)
+    remapped = remap_pretrained_params(
+        params, {"text_encoder": converted}, {"text_encoder": "encoder/text_encoder"}
+    )
+    got = remapped["encoder"]["text_encoder"]["positional_embedding"]
+    np.testing.assert_array_equal(
+        np.asarray(got), sd["positional_embedding"]
+    )
+    # Forward still runs with the remapped tree.
+    out = model.apply({"params": remapped}, obs, train=False)
+    assert out.shape == (2, 2)

@@ -183,3 +183,26 @@ def test_inference_matches_training_logits(policy_and_params, rng):
         np.asarray(out_train["action_logits"])[:, -1],
         atol=1e-5,
     )
+
+
+def test_params_are_time_sequence_length_invariant(rng):
+    """Pins the bench.py infer-mode init trick (`bench.py:120-124`): params
+    initialized with a time_sequence_length=1 clone must be structurally and
+    shape-wise identical to the full-T model's (the positional table floors
+    at 256 rows, so no parameter depends on T). If a posemb change ever makes
+    params T-dependent, this fails before the bench silently loads garbage."""
+    model_t = tiny_policy()
+    model_1 = model_t.clone(time_sequence_length=1)
+    obs, actions = make_batch(rng, b=1)
+    obs1 = jax.tree.map(lambda x: x[:, :1], obs)
+    act1 = jax.tree.map(lambda x: x[:, :1], actions)
+    p_t = model_t.init({"params": rng, "crop": rng}, obs, actions, train=False)
+    p_1 = model_1.init({"params": rng, "crop": rng}, obs1, act1, train=False)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a.shape, b.shape),
+        p_t["params"],
+        p_1["params"],
+    )
+    # And the t=1 params actually run under the full-T model.
+    out = model_t.apply(p_1, obs, actions, train=False)
+    assert np.isfinite(float(out["loss"]))

@@ -39,6 +39,28 @@ def test_train_loop_synthetic_and_resume(tmp_path):
         np.testing.assert_allclose(a, b)
 
 
+def test_train_loop_lava_family_and_resume(tmp_path):
+    """One command trains LAVA: family switch through the same loop
+    (reference Stack B `language_table/train/train.py:105-116`)."""
+    from rt1_tpu.train.configs import lava_tiny
+    from rt1_tpu.train.train import train_and_evaluate
+
+    config = lava_tiny.get_config()
+    config.num_steps = 3
+    config.checkpoint_every_steps = 1
+    workdir = str(tmp_path / "lava_run")
+    state = train_and_evaluate(config, workdir)
+    assert int(state.step) == 3
+    assert "encoder" in state.params  # SequenceLAVMSE tree, not RT-1's
+
+    state2 = train_and_evaluate(config, workdir)
+    assert int(state2.step) == 3
+    p1 = jax.tree.leaves(jax.device_get(state.params))
+    p2 = jax.tree.leaves(jax.device_get(state2.params))
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(a, b)
+
+
 def test_checkpoint_manager_roundtrip(tmp_path):
     from rt1_tpu.trainer.checkpoints import (
         CheckpointConfig,
@@ -108,3 +130,9 @@ def test_collect_lifecycle(tmp_path):
     config.data.loader = "numpy"
     state = train_and_evaluate(config, str(tmp_path / "run2"))
     assert int(state.step) == 2
+    # Dataset provenance is stamped next to the checkpoints for eval-time
+    # embedder-mismatch detection.
+    import json
+
+    with open(tmp_path / "run2" / "data_manifest.json") as f:
+        assert json.load(f)["embedder"] == "hash"
