@@ -29,7 +29,17 @@ def main():
     # "train": train-step throughput (the driver's metric). "infer": closed-
     # loop control-step latency of the jitted single-pass infer_step at
     # batch 1 (the reference's 10 Hz budget, SURVEY.md §7 hard part 3).
-    p.add_argument("--mode", default="train", choices=["train", "infer"])
+    # "e2e": the REAL training path — windowed episode pipeline feeding
+    # uint8 batches through the double-buffered device prefetch (VERDICT r1
+    # weak #1: the compute-only bench hid the input pipeline). Also prints a
+    # stderr detail line with compute-only vs end-to-end and the stall %.
+    # "mfu": model-flops-utilization estimate from XLA cost analysis.
+    p.add_argument(
+        "--mode", default="train", choices=["train", "infer", "e2e", "mfu"]
+    )
+    p.add_argument(
+        "--data_dir", default="/tmp/rt1_bench_episodes",
+        help="e2e mode: episode cache dir (synthesized on first run).")
     args = p.parse_args()
 
     import jax
@@ -75,31 +85,178 @@ def main():
     state = fns.shard_state(state)
     batch = fns.shard_batch((obs, actions))
 
-    for i in range(args.warmup):
-        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+    def timed_resident_loop(state, steps, warmup):
+        for i in range(warmup):
+            state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+            jax.block_until_ready(metrics["loss"])
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, 100 + i))
         jax.block_until_ready(metrics["loss"])
+        return state, time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, 100 + i))
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    if args.mode == "mfu":
+        return mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop)
 
+    if args.mode == "e2e":
+        return e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop)
+
+    state, dt = timed_resident_loop(state, args.steps, args.warmup)
     steps_per_sec_per_chip = args.steps / dt / n_chips
-    baseline = None
-    try:
-        with open("BASELINE.json") as f:
-            baseline = json.load(f)["published"].get("train_steps_per_sec_per_chip")
-    except Exception:
-        pass
-    vs = steps_per_sec_per_chip / baseline if baseline else 1.0
+    vs = _vs_baseline(steps_per_sec_per_chip, "train_steps_per_sec_per_chip")
     print(
         json.dumps(
             {
                 "metric": "train_steps_per_sec_per_chip",
                 "value": round(steps_per_sec_per_chip, 4),
                 "unit": "steps/s/chip",
-                "vs_baseline": round(vs, 4),
+                "vs_baseline": vs,
+            }
+        )
+    )
+
+
+def _vs_baseline(value, key):
+    try:
+        with open("BASELINE.json") as f:
+            baseline = json.load(f)["published"].get(key)
+    except Exception:
+        baseline = None
+    return round(value / baseline, 4) if baseline else 1.0
+
+
+def _ensure_bench_episodes(data_dir, n_episodes=24, steps_per_episode=40):
+    """Synthesize a cached corpus of native-resolution (180x320) episodes."""
+    import glob
+    import os
+
+    import numpy as np
+
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+
+    paths = sorted(glob.glob(os.path.join(data_dir, "episode_*.npz")))
+    if len(paths) >= n_episodes:
+        return paths[:n_episodes]
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n_episodes):
+        save_episode(
+            os.path.join(data_dir, f"episode_{i}.npz"),
+            generate_synthetic_episode(rng, num_steps=steps_per_episode),
+        )
+    return sorted(glob.glob(os.path.join(data_dir, "episode_*.npz")))
+
+
+def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
+    """Pipeline-fed steps: host windowing/augment -> uint8 H2D (double-
+    buffered) -> device step. The number BASELINE.md's wall-clock north star
+    actually cares about; `stall_pct` on stderr is the input-bound fraction.
+    """
+    import sys
+
+    import jax
+
+    from rt1_tpu.data.pipeline import WindowedEpisodeDataset, prefetch_to_device
+
+    paths = _ensure_bench_episodes(args.data_dir)
+    ds = WindowedEpisodeDataset(
+        paths, window=6, crop_factor=0.95, height=args.height, width=args.width
+    )
+    tfds = ds.as_tf_dataset(batch_size=args.batch, seed=0)
+    feed = prefetch_to_device(
+        map(
+            lambda b: (b["observations"], b["actions"]),
+            tfds.as_numpy_iterator(),
+        ),
+        fns.batch_sharding,
+        depth=2,
+    )
+
+    # Warmup compiles both the uint8-input step and fills the prefetch queue.
+    for i in range(args.warmup):
+        state, metrics = fns.train_step(state, next(feed), jax.random.fold_in(rng, i))
+        jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = fns.train_step(
+            state, next(feed), jax.random.fold_in(rng, 100 + i)
+        )
+    jax.block_until_ready(metrics["loss"])
+    dt_e2e = time.perf_counter() - t0
+
+    # Compute-only on the same resident float batch for the stall estimate.
+    state, dt_compute = timed_resident_loop(state, args.steps, 1)
+
+    e2e = args.steps / dt_e2e / n_chips
+    compute_only = args.steps / dt_compute / n_chips
+    stall_pct = max(0.0, 1.0 - dt_compute / dt_e2e) * 100
+    print(
+        json.dumps(
+            {
+                "mode": "e2e_detail",
+                "compute_only_steps_per_sec_per_chip": round(compute_only, 4),
+                "e2e_steps_per_sec_per_chip": round(e2e, 4),
+                "input_stall_pct": round(stall_pct, 2),
+            }
+        ),
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_steps_per_sec_per_chip_e2e",
+                "value": round(e2e, 4),
+                "unit": "steps/s/chip",
+                "vs_baseline": _vs_baseline(
+                    e2e, "train_steps_per_sec_per_chip_e2e"
+                ),
+            }
+        )
+    )
+
+
+def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop):
+    """MFU = measured FLOP/s / peak FLOP/s, with FLOPs from XLA's own cost
+    analysis of the compiled train step (fwd+bwd+update, the whole program).
+    Peak defaults to a v5e chip's bf16 197 TFLOP/s; override with
+    RT1_TPU_PEAK_FLOPS for other generations.
+    """
+    import os
+    import sys
+
+    import jax
+
+    compiled = fns.train_step.lower(
+        state, batch, jax.random.fold_in(rng, 0)
+    ).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops = float(cost.get("flops", 0.0))
+
+    state, dt = timed_resident_loop(state, args.steps, args.warmup)
+    dt_per_step = dt / args.steps
+
+    peak = float(os.environ.get("RT1_TPU_PEAK_FLOPS", 197e12))
+    mfu = flops / dt_per_step / (peak * n_chips) * 100
+    print(
+        json.dumps(
+            {
+                "mode": "mfu_detail",
+                "flops_per_step": flops,
+                "sec_per_step": round(dt_per_step, 6),
+                "peak_flops_assumed": peak,
+            }
+        ),
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_mfu",
+                "value": round(mfu, 3),
+                "unit": "%",
+                "vs_baseline": 1.0,
             }
         )
     )

@@ -51,6 +51,15 @@ class RldsPipelineConfig:
     # tf.data service endpoint ("grpc://host:port"); None = run locally.
     data_service_address: Optional[str] = None
     data_service_job_name: Optional[str] = "rt1_tpu_train"
+    # "uint8" ships 4x fewer H2D bytes (model converts on device);
+    # "float32" keeps the legacy [0,1] host representation.
+    image_dtype: str = "uint8"
+
+    def __post_init__(self):
+        if self.image_dtype not in ("uint8", "float32"):
+            raise ValueError(
+                f"image_dtype must be uint8|float32, got {self.image_dtype!r}"
+            )
 
 
 def pad_episode(steps: Dict, window: int):
@@ -87,7 +96,7 @@ def episode_windows(steps: Dict, window: int):
 
 
 def _augment_images(rgb, cfg: RldsPipelineConfig, training: bool):
-    """uint8 (window, h, w, 3) -> float32 [0,1] (window, H, W, 3).
+    """uint8 (window, h, w, 3) -> (window, H, W, 3), cfg.image_dtype.
 
     Random-crop at `crop_factor` with a uniform offset per frame (parity
     with `DecodeAndRandomResizedCrop`, independent offsets per frame), then
@@ -124,6 +133,10 @@ def _augment_images(rgb, cfg: RldsPipelineConfig, training: bool):
 
         rgb = tf.map_fn(jitter, rgb)
         rgb = tf.clip_by_value(rgb, 0.0, 1.0)
+    if cfg.image_dtype == "uint8":
+        # Quantize back for the wire; the model's on-device convert_dtype
+        # restores [0,1] floats. Round-trip error is <= 1/510 per channel.
+        rgb = tf.cast(tf.round(rgb * 255.0), tf.uint8)
     return rgb
 
 

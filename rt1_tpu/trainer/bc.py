@@ -115,10 +115,17 @@ def remap_pretrained_params(
 
 
 def adapt_obs_for_lava(obs: Dict[str, Any]) -> Dict[str, Any]:
-    """Windowed-pipeline observation keys -> LAVA's (`image` -> `rgb`)."""
+    """Windowed-pipeline observations -> LAVA's: rename `image` -> `rgb` and
+    convert the wire dtype (uint8 by default since the H2D-bytes change) to
+    the [0,1] floats LAVA's conv towers and ImageNet normalization expect —
+    the same on-device conversion RT-1 does in `rt1.py::_preprocess`."""
+    from rt1_tpu.ops.image import convert_dtype
+
     lava_obs = dict(obs)
     if "rgb" not in lava_obs and "image" in lava_obs:
         lava_obs["rgb"] = lava_obs.pop("image")
+    if "rgb" in lava_obs:
+        lava_obs["rgb"] = convert_dtype(lava_obs["rgb"])
     return lava_obs
 
 

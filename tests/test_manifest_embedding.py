@@ -27,8 +27,11 @@ def test_manifest_roundtrip_and_enforcement(tmp_path):
     # Matching spec passes and returns the manifest.
     m = check_embedder_compatibility(d, "ngram")
     assert m["reward"] == "block2block"
-    # Instance specs resolve via their .name.
-    assert check_embedder_compatibility(d, NgramInstructionEmbedder()) is m or True
+    # Instance specs resolve via their .name: ngram instance passes, a
+    # mismatched instance raises.
+    assert check_embedder_compatibility(d, NgramInstructionEmbedder()) == m
+    with pytest.raises(ValueError, match="Embedder mismatch"):
+        check_embedder_compatibility(d, get_embedder("hash"))
 
     with pytest.raises(ValueError, match="Embedder mismatch"):
         check_embedder_compatibility(d, "hash")

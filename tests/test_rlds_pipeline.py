@@ -120,7 +120,18 @@ def test_random_crop_and_photometric_shapes():
     b = next(iter(ds.as_numpy_iterator()))
     img = b["observations"]["image"]
     assert img.shape == (2, 2, 12, 20, 3)
-    assert img.min() >= 0.0 and img.max() <= 1.0
+    assert img.dtype == np.uint8  # wire format; device converts to [0,1]
+
+    cfg_f = RldsPipelineConfig(
+        window=2, crop_factor=0.9, height=12, width=20,
+        photometric=True, batch_size=2, repeat=False, shuffle_buffer=4,
+        image_dtype="float32",
+    )
+    ds_f = windowed_rlds_dataset(make_episode_dataset_from_arrays(eps), cfg_f,
+                                 training=True)
+    img_f = next(iter(ds_f.as_numpy_iterator()))["observations"]["image"]
+    assert img_f.dtype == np.float32
+    assert img_f.min() >= 0.0 and img_f.max() <= 1.0
 
 
 def test_tf_data_service_roundtrip():
