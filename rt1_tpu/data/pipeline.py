@@ -274,18 +274,26 @@ def prefetch_to_device(iterator, sharding, depth: int = 2) -> Iterator:
         yield queue.popleft()
 
 
-def device_feeder(iterator, batch_sharding) -> Iterator:
-    """Lay host batches out on the mesh as (observations, actions) tuples of
-    sharded jax.Arrays — the multi-host story is `jax.make_array_from_
-    process_local_data` semantics: each host feeds its shard of the batch."""
+def to_obs_actions(batch):
+    """Loader batch dict -> the (observations, actions) tuple steps consume.
+
+    tf.data yields dicts whose leaves are EagerTensors; numpy loaders yield
+    dicts of ndarrays. Normalize leaves, not the container.
+    """
     import jax
 
-    for batch in iterator:
-        # tf.data yields dicts whose leaves are EagerTensors; numpy loaders
-        # yield dicts of ndarrays. Normalize leaves, not the container.
-        b = jax.tree.map(
-            lambda x: x.numpy() if hasattr(x, "numpy") else np.asarray(x),
-            batch,
-        )
-        obs, actions = b["observations"], b["actions"]
-        yield jax.device_put((obs, actions), batch_sharding)
+    b = jax.tree.map(
+        lambda x: x.numpy() if hasattr(x, "numpy") else np.asarray(x),
+        batch,
+    )
+    return b["observations"], b["actions"]
+
+
+def device_feeder(iterator, batch_sharding, depth: int = 1) -> Iterator:
+    """Lay host batches out on the mesh as (observations, actions) tuples of
+    sharded jax.Arrays — the multi-host story is `jax.make_array_from_
+    process_local_data` semantics: each host feeds its shard of the batch.
+    `depth=2` double-buffers (see `prefetch_to_device`)."""
+    return prefetch_to_device(
+        map(to_obs_actions, iterator), batch_sharding, depth=depth
+    )

@@ -12,6 +12,9 @@ from rt1_tpu.envs.backends.kinematic import KinematicBackend
 def make_backend(name="auto", **kwargs):
     if name == "kinematic":
         return KinematicBackend(**kwargs)
+    if name == "kinematic_arm":
+        # xArm6 FK/IK in the control loop (reference arm-physics parity).
+        return KinematicBackend(arm="kinematic", **kwargs)
     if name in ("auto", "pybullet"):
         try:
             from rt1_tpu.envs.backends.pybullet_backend import PyBulletBackend

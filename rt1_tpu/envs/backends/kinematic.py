@@ -30,11 +30,19 @@ _RELAX_ITERS = 4
 
 
 class KinematicBackend:
-    """Quasi-static 2-D board physics."""
+    """Quasi-static 2-D board physics.
+
+    `arm="kinematic"` puts the xArm6 kinematic chain in the loop (the role
+    PyBullet's URDF arm plays in the reference, `language_table.py:599-646` +
+    `utils/xarm_sim_robot.py:154-187`): each control step solves
+    damped-least-squares IK for the target effector pose and sweeps the
+    effector along the joint-space interpolation's FK trace, so motion
+    follows arm-feasible arcs instead of straight board-frame lines.
+    """
 
     name = "kinematic"
 
-    def __init__(self, block_names=None):
+    def __init__(self, block_names=None, arm="none"):
         if block_names is None:
             from rt1_tpu.envs import blocks as blocks_module
 
@@ -48,6 +56,48 @@ class KinematicBackend:
             [constants.CENTER_X, constants.CENTER_Y], dtype=np.float64
         )
         self._effector_target_xy = self._effector_xy.copy()
+
+        if arm not in ("none", "kinematic"):
+            raise ValueError(f"arm must be 'none'|'kinematic', got {arm!r}")
+        self._arm = None
+        self._arm_joints = None
+        if arm == "kinematic":
+            from rt1_tpu.envs.utils.xarm import (
+                HOME_JOINT_POSITIONS,
+                XArmKinematics,
+            )
+
+            self._arm = XArmKinematics()
+            self._arm_joints = np.array(HOME_JOINT_POSITIONS, np.float64)
+            self._sync_arm_to_effector()
+
+    # -- arm-in-the-loop ------------------------------------------------
+
+    def _effector_pose(self, xy):
+        """Board-frame effector pose for IK: tool at the pushing height,
+        flange pointing down (reference cylinder orientation)."""
+        from scipy.spatial import transform
+
+        from rt1_tpu.envs.utils.pose3d import Pose3d
+
+        return Pose3d(
+            rotation=transform.Rotation.from_euler("xyz", [np.pi, 0.0, 0.0]),
+            translation=np.array(
+                [xy[0], xy[1], constants.EFFECTOR_HEIGHT]
+            ),
+        )
+
+    def _sync_arm_to_effector(self):
+        q = self._arm.inverse(
+            self._effector_pose(self._effector_xy),
+            initial_joints=self._arm_joints,
+        )
+        if q is not None:
+            self._arm_joints = q
+
+    def arm_joints(self):
+        """Current joint configuration (None when the arm is disabled)."""
+        return None if self._arm_joints is None else self._arm_joints.copy()
 
     # -- poses ----------------------------------------------------------
 
@@ -76,6 +126,8 @@ class KinematicBackend:
     def teleport_effector(self, xy):
         self._effector_xy = np.asarray(xy, dtype=np.float64).copy()
         self._effector_target_xy = self._effector_xy.copy()
+        if self._arm is not None:
+            self._sync_arm_to_effector()
 
     def set_effector_target(self, xy):
         self._effector_target_xy = np.asarray(xy, dtype=np.float64).copy()
@@ -86,12 +138,42 @@ class KinematicBackend:
         """Advance one control period: sweep effector to target, push blocks."""
         start = self._effector_xy
         end = self._effector_target_xy
+        sweep = None
+        if self._arm is not None:
+            sweep = self._arm_sweep(end, n_substeps)
         for k in range(1, n_substeps + 1):
-            self._effector_xy = start + (end - start) * (k / n_substeps)
+            if sweep is not None:
+                self._effector_xy = sweep[k - 1]
+            else:
+                self._effector_xy = start + (end - start) * (k / n_substeps)
             self._resolve_contacts()
         # Eliminate residual drift so repeated zero-actions are stable.
         self._effector_xy = end.copy()
         self._resolve_contacts()
+        # A successful sweep already left _arm_joints at IK(end); only the
+        # (rare, out-of-workspace) straight-line fallback needs a re-sync.
+        if self._arm is not None and sweep is None:
+            self._sync_arm_to_effector()
+
+    def _arm_sweep(self, target_xy, n_substeps):
+        """FK trace of the joint-space interpolation toward IK(target).
+
+        Falls back to None (straight-line sweep) when the target is outside
+        the arm's reachable workspace — mirroring the reference, where an
+        unreachable IK target leaves the arm at its best-effort pose.
+        """
+        q_target = self._arm.inverse(
+            self._effector_pose(target_xy), initial_joints=self._arm_joints
+        )
+        if q_target is None:
+            return None
+        q0 = self._arm_joints
+        trace = []
+        for k in range(1, n_substeps + 1):
+            q = q0 + (q_target - q0) * (k / n_substeps)
+            trace.append(self._arm.forward(q).translation[:2])
+        self._arm_joints = q_target
+        return trace
 
     def stabilize(self, nsteps=100):
         """Quasi-static model has no residual dynamics; just settle contacts."""
@@ -139,12 +221,15 @@ class KinematicBackend:
 
     def get_state(self):
         """Deep-copied snapshot; `set_state` restores it bit-for-bit."""
-        return {
+        state = {
             "block_xy": self._block_xy.copy(),
             "block_yaw": self._block_yaw.copy(),
             "effector_xy": self._effector_xy.copy(),
             "effector_target_xy": self._effector_target_xy.copy(),
         }
+        if self._arm_joints is not None:
+            state["arm_joints"] = self._arm_joints.copy()
+        return state
 
     def set_state(self, state):
         self._block_xy = np.array(state["block_xy"], dtype=np.float64)
@@ -153,3 +238,11 @@ class KinematicBackend:
         self._effector_target_xy = np.array(
             state["effector_target_xy"], dtype=np.float64
         )
+        if self._arm is not None:
+            if "arm_joints" in state:
+                self._arm_joints = np.array(state["arm_joints"], np.float64)
+            else:
+                # Snapshot from an arm-less backend (cross-backend restore):
+                # re-derive joints from the restored effector pose so the
+                # next sweep doesn't interpolate from a stale configuration.
+                self._sync_arm_to_effector()

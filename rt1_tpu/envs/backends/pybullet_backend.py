@@ -117,16 +117,21 @@ class PyBulletBackend:  # pragma: no cover - requires pybullet + assets
             self._client.stepSimulation()
 
     def get_state(self):
+        """Same stacked-array schema as KinematicBackend.get_state, so
+        callers can switch backends without translating snapshots."""
+        poses = [self.block_pose(name) for name in self._block_names]
         return {
-            name: self.block_pose(name) for name in self._block_names
-        } | {
+            "block_xy": np.stack([xy for xy, _ in poses]),
+            "block_yaw": np.array([yaw for _, yaw in poses]),
             "effector_xy": self._effector_xy.copy(),
             "effector_target_xy": self._effector_target_xy.copy(),
         }
 
     def set_state(self, state):
-        for name in self._block_names:
-            xy, yaw = state[name]
-            self.set_block_pose(name, xy, yaw)
+        for i, name in enumerate(self._block_names):
+            self.set_block_pose(
+                name, state["block_xy"][i], float(state["block_yaw"][i])
+            )
         self._effector_xy = np.array(state["effector_xy"])
         self._effector_target_xy = np.array(state["effector_target_xy"])
+        self._place_effector(self._effector_xy)

@@ -288,15 +288,10 @@ def train_and_evaluate(config, workdir: str):
     # step N (uint8 images by default — 4x fewer bytes than float32).
     import itertools
 
-    from rt1_tpu.data.pipeline import prefetch_to_device
+    from rt1_tpu.data.pipeline import device_feeder
 
-    dev_iter = prefetch_to_device(
-        map(
-            lambda b: (b["observations"], b["actions"]),
-            itertools.chain([first], train_iter),
-        ),
-        fns.batch_sharding,
-        depth=2,
+    dev_iter = device_feeder(
+        itertools.chain([first], train_iter), fns.batch_sharding, depth=2
     )
     for step in range(initial_step, config.num_steps):
         with step_trace("train", step):

@@ -1,0 +1,122 @@
+"""Worker body for the 2-process jax.distributed smoke test.
+
+Launched (twice) by tests/test_distributed.py with:
+  python tests/distributed_worker.py <process_id> <coordinator_port> <workdir>
+
+Covers the multihost surface the reference exercises in anger
+(`language_table/train/train.py:124-140`: per-host data sharding + multihost
+checkpointing) on two CPU processes with 4 virtual devices each.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+
+def main():
+    process_id = int(sys.argv[1])
+    port = sys.argv[2]
+    workdir = sys.argv[3]
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 8
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # --- per-host data sharding: each host loads a disjoint window stripe.
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+    from rt1_tpu.data.pipeline import WindowedEpisodeDataset
+
+    data_dir = os.path.join(workdir, "data")
+    if process_id == 0:
+        os.makedirs(data_dir, exist_ok=True)
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            save_episode(
+                os.path.join(data_dir, f"episode_{i}.npz"),
+                generate_synthetic_episode(rng, num_steps=6, height=16, width=24),
+            )
+        open(os.path.join(workdir, "data_ready"), "w").close()
+    else:
+        import time
+
+        for _ in range(600):
+            if os.path.exists(os.path.join(workdir, "data_ready")):
+                break
+            time.sleep(0.05)
+
+    paths = sorted(
+        os.path.join(data_dir, f) for f in os.listdir(data_dir)
+        if f.endswith(".npz")
+    )
+    ds = WindowedEpisodeDataset(paths, window=2, height=16, width=24)
+    my_windows = [
+        i
+        for i in range(len(ds.index))
+        if i % jax.process_count() == jax.process_index()
+    ]
+    # The two hosts see disjoint halves covering everything.
+    with open(os.path.join(workdir, f"windows_{process_id}.txt"), "w") as f:
+        f.write(",".join(map(str, my_windows)))
+
+    # --- global mesh over both hosts' devices + a multihost jax.Array.
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    global_shape = (8, 3)
+    local = np.arange(8 * 3, dtype=np.float32).reshape(global_shape)[
+        jax.process_index() * 4 : (jax.process_index() + 1) * 4
+    ]
+    arr = jax.make_array_from_process_local_data(sharding, local, global_shape)
+    assert arr.shape == global_shape
+
+    # --- Orbax multihost save/restore of the sharded array.
+    from rt1_tpu.trainer.checkpoints import CheckpointConfig, CheckpointManager
+
+    mgr = CheckpointManager(
+        CheckpointConfig(
+            directory=os.path.join(workdir, "ckpt"), save_interval_steps=1
+        )
+    )
+    state = {"w": arr, "step": np.asarray(3, np.int32)}
+    assert mgr.save(1, state)
+    mgr.wait_until_finished()
+
+    zeros_local = np.zeros_like(local)
+    template = {
+        "w": jax.make_array_from_process_local_data(
+            sharding, zeros_local, global_shape
+        ),
+        "step": np.asarray(0, np.int32),
+    }
+    restored, step = mgr.restore_or_initialize(template)
+    assert step == 1
+    got_local = np.concatenate(
+        [np.asarray(s.data) for s in restored["w"].addressable_shards]
+    )
+    np.testing.assert_array_equal(got_local, local)
+    mgr.close()
+
+    with open(os.path.join(workdir, f"ok_{process_id}"), "w") as f:
+        f.write("ok")
+    print(f"worker {process_id}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

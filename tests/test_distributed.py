@@ -1,0 +1,61 @@
+"""2-process jax.distributed smoke test (VERDICT r1 missing #8).
+
+Spawns two CPU processes with 4 virtual devices each (a 2-host x 4-device
+topology), covering: distributed init, per-host window striding, building a
+multihost jax.Array over a global mesh, and Orbax multihost save/restore —
+the surfaces the reference ran multihost in anger
+(`language_table/train/main.py:54`, `train/train.py:124-140`).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed(tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # Strip this (single-process) test session's device-count override
+        # and any TPU tunnel claim from the children.
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outputs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        outputs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert os.path.exists(tmp_path / f"ok_{i}")
+
+    # The two hosts' window stripes are disjoint and jointly complete.
+    stripes = []
+    for i in range(2):
+        with open(tmp_path / f"windows_{i}.txt") as f:
+            stripes.append({int(x) for x in f.read().split(",") if x})
+    assert stripes[0].isdisjoint(stripes[1])
+    total = len(stripes[0] | stripes[1])
+    assert total == 18  # 3 episodes x 6 steps = 18 windows
