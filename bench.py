@@ -85,13 +85,14 @@ def main():
     state = fns.shard_state(state)
     batch = fns.shard_batch((obs, actions))
 
-    def timed_resident_loop(state, steps, warmup):
+    def timed_resident_loop(state, steps, warmup, resident=None):
+        resident = batch if resident is None else resident
         for i in range(warmup):
-            state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+            state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, i))
             jax.block_until_ready(metrics["loss"])
         t0 = time.perf_counter()
         for i in range(steps):
-            state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, 100 + i))
+            state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, 100 + i))
         jax.block_until_ready(metrics["loss"])
         return state, time.perf_counter() - t0
 
@@ -182,16 +183,7 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
     jax.block_until_ready(metrics["loss"])
     dt_e2e = time.perf_counter() - t0
 
-    for i in range(1):  # warm re-entry after the e2e loop
-        state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, 7))
-        jax.block_until_ready(metrics["loss"])
-    t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = fns.train_step(
-            state, resident, jax.random.fold_in(rng, 200 + i)
-        )
-    jax.block_until_ready(metrics["loss"])
-    dt_compute = time.perf_counter() - t0
+    state, dt_compute = timed_resident_loop(state, args.steps, 1, resident=resident)
 
     e2e = args.steps / dt_e2e / n_chips
     compute_only = args.steps / dt_compute / n_chips

@@ -66,7 +66,13 @@ def collect_episode(
         t += 1
     if not done:
         return None  # oracle failed; skip unsuccessful demos
-    return {k: np.stack(v) for k, v in steps.items()}
+    episode = {k: np.stack(v) for k, v in steps.items()}
+    # Raw instruction alongside its embedding: enables re-embedding with a
+    # different provider and in-pipeline CLIP tokenization (LAVA "clip").
+    from rt1_tpu.data.episodes import encode_instruction_text
+
+    episode["instruction_text"] = encode_instruction_text(env.instruction_str)
+    return episode
 
 
 def collect_dataset(

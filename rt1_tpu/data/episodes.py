@@ -10,6 +10,14 @@ An episode is a dict of arrays stacked over time (T steps):
 * ``instruction``  (T, 512) float32 — USE embedding of the instruction
   (`rlds_np_convert.py:28`), or (T, L) int32 raw encoded bytes pre-embedding
 
+Optional keys:
+
+* ``instruction_text`` (L,) uint8 — the raw instruction as UTF-8 bytes
+  (`encode_instruction_text`). Stored as bytes, not a unicode array, so the
+  native C++ reader's numeric-dtype fast path still covers the whole file.
+  Enables re-embedding with a different provider and in-pipeline CLIP BPE
+  tokenization for the LAVA "clip" language encoder.
+
 Stored as one compressed-free `.npz` per episode (zero-copy mmap-able, no pickle),
 vs the reference's pickled list-of-dicts `.npy` (`rlds_np_convert.py:31`) which
 must be fully unpickled per access. `read_reference_episode` reads that legacy
@@ -26,6 +34,15 @@ import numpy as np
 Episode = Dict[str, np.ndarray]
 
 REQUIRED_KEYS = ("rgb", "action", "is_first", "is_terminal", "instruction")
+
+
+def encode_instruction_text(text: str) -> np.ndarray:
+    """Instruction string -> (L,) uint8 UTF-8 bytes (native-reader friendly)."""
+    return np.frombuffer(text.encode("utf-8"), np.uint8).copy()
+
+
+def decode_instruction_text(arr: np.ndarray) -> str:
+    return bytes(np.asarray(arr, np.uint8)).decode("utf-8")
 
 
 def validate_episode(ep: Episode) -> None:

@@ -41,6 +41,7 @@ class WindowedEpisodeDataset:
         reader: Callable[[str], ep_lib.Episode] = ep_lib.load_episode,
         cache_episodes: int = 64,
         image_dtype: str = "uint8",
+        clip_tokenizer=None,
     ):
         if image_dtype not in ("uint8", "float32"):
             raise ValueError(f"image_dtype must be uint8|float32, got {image_dtype}")
@@ -53,6 +54,12 @@ class WindowedEpisodeDataset:
         # converts on device (`ops/image.py::convert_dtype`), and the
         # reference stores/augments uint8 rgb anyway (VERDICT r1 weak #2).
         self.image_dtype = image_dtype
+        # Optional ClipBPETokenizer: windows gain an
+        # "instruction_tokenized_clip" (window, context) observation, fed to
+        # LAVA's in-graph CLIP text tower (reference tokenizes in the input
+        # pipeline, `input_pipeline_rlds.py` + clip_tokenizer.py).
+        self._clip_tokenizer = clip_tokenizer
+        self._clip_token_cache: Dict[int, np.ndarray] = {}
         self._reader = reader
         self._cache: "collections.OrderedDict[int, ep_lib.Episode]" = collections.OrderedDict()
         self._cache_size = cache_episodes
@@ -122,16 +129,38 @@ class WindowedEpisodeDataset:
             actions.append(self._padded_step(ep, j, "action"))
             terms.append(np.int32(bool(self._padded_step(ep, j, "is_terminal"))))
 
+        observations = {
+            "image": np.stack(images),
+            "natural_language_embedding": np.stack(embeds).astype(np.float32),
+        }
+        if self._clip_tokenizer is not None:
+            tokens = self._episode_clip_tokens(ep_i)
+            observations["instruction_tokenized_clip"] = np.tile(
+                tokens, (self.window, 1)
+            )
         return {
-            "observations": {
-                "image": np.stack(images),
-                "natural_language_embedding": np.stack(embeds).astype(np.float32),
-            },
+            "observations": observations,
             "actions": {
                 "terminate_episode": np.asarray(terms, np.int32),
                 "action": np.stack(actions).astype(np.float32),
             },
         }
+
+    def _episode_clip_tokens(self, ep_i: int) -> np.ndarray:
+        """(context,) int32 CLIP BPE frame for the episode's instruction."""
+        tokens = self._clip_token_cache.get(ep_i)
+        if tokens is None:
+            ep = self._episode(ep_i)
+            if "instruction_text" not in ep:
+                raise KeyError(
+                    f"{self.paths[ep_i]} has no 'instruction_text' member; "
+                    "re-collect with a current rt1_tpu.data.collect to use "
+                    "clip_tokenizer"
+                )
+            text = ep_lib.decode_instruction_text(ep["instruction_text"])
+            tokens = self._clip_tokenizer.tokenize_text(text)[0].astype(np.int32)
+            self._clip_token_cache[ep_i] = tokens
+        return tokens
 
     # ------------------------------------------------------------------ loaders
 
@@ -184,29 +213,43 @@ class WindowedEpisodeDataset:
         if shuffle:
             ds = ds.shuffle(min(n, shuffle_buffer), seed=seed, reshuffle_each_iteration=True)
 
+        with_tokens = self._clip_tokenizer is not None
+
         def _load(idx):
             def _py(i):
                 s = self.get_window(int(i))
-                return (
+                out = [
                     s["observations"]["image"],
                     s["observations"]["natural_language_embedding"],
                     s["actions"]["terminate_episode"],
                     s["actions"]["action"],
-                )
+                ]
+                if with_tokens:
+                    out.append(s["observations"]["instruction_tokenized_clip"])
+                return tuple(out)
 
             img_tf_dtype = (
                 tf.uint8 if self.image_dtype == "uint8" else tf.float32
             )
-            img, emb, term, act = tf.numpy_function(
-                _py, [idx], [img_tf_dtype, tf.float32, tf.int32, tf.float32]
-            )
+            dtypes = [img_tf_dtype, tf.float32, tf.int32, tf.float32]
+            if with_tokens:
+                dtypes.append(tf.int32)
+            tensors = tf.numpy_function(_py, [idx], dtypes)
+            img, emb, term, act = tensors[:4]
             w = self.window
             img.set_shape((w, self.height, self.width, 3))
             emb.set_shape((w, None))
             term.set_shape((w,))
             act.set_shape((w, None))
+            observations = {
+                "image": img, "natural_language_embedding": emb,
+            }
+            if with_tokens:
+                tokens = tensors[4]
+                tokens.set_shape((w, self._clip_tokenizer.context_length))
+                observations["instruction_tokenized_clip"] = tokens
             return {
-                "observations": {"image": img, "natural_language_embedding": emb},
+                "observations": observations,
                 "actions": {"terminate_episode": term, "action": act},
             }
 

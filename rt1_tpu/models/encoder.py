@@ -14,7 +14,6 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from rt1_tpu.models.efficientnet import EfficientNetB3
 from rt1_tpu.models.film import FilmConditioning
 
 
@@ -23,6 +22,10 @@ class EfficientNetEncoder(nn.Module):
     early_film: bool = True
     pooling: bool = True
     dtype: jnp.dtype = jnp.float32
+    # B3 scaling by default; smaller coefficients give the same architecture
+    # family at CPU-trainable cost (e.g. 0.35/0.35 ~ a MobileNet-size tower).
+    width_coefficient: float = 1.2
+    depth_coefficient: float = 1.4
 
     @nn.compact
     def __call__(
@@ -32,7 +35,16 @@ class EfficientNetEncoder(nn.Module):
         train: bool = False,
     ) -> jnp.ndarray:
         """image: (B, H, W, 3); context: (B, 512). Returns (B, h, w, E) or (B, E)."""
-        net = EfficientNetB3(include_top=False, include_film=self.early_film, dtype=self.dtype)
+        from rt1_tpu.models.efficientnet import EfficientNet
+
+        net = EfficientNet(
+            width_coefficient=self.width_coefficient,
+            depth_coefficient=self.depth_coefficient,
+            dropout_rate=0.3,
+            include_top=False,
+            include_film=self.early_film,
+            dtype=self.dtype,
+        )
         if self.early_film:
             features = net(image, context=context, train=train)
         else:

@@ -30,6 +30,8 @@ class RT1ImageTokenizer(nn.Module):
     use_token_learner: bool = True
     num_tokens: int = 8
     dtype: jnp.dtype = jnp.float32
+    width_coefficient: float = 1.2   # B3 default
+    depth_coefficient: float = 1.4
 
     @nn.compact
     def __call__(
@@ -51,6 +53,8 @@ class RT1ImageTokenizer(nn.Module):
             early_film=True,
             pooling=False,
             dtype=self.dtype,
+            width_coefficient=self.width_coefficient,
+            depth_coefficient=self.depth_coefficient,
             name="encoder",
         )(image, context=context, train=train)  # (B*T, h', w', E)
         if self.use_token_learner:

@@ -199,3 +199,15 @@ class ClipBPETokenizer:
                 )
             out[row, : len(ids)] = ids
         return out
+
+
+def default_tokenizer(context_length: int = CLIP_CONTEXT_LENGTH) -> ClipBPETokenizer:
+    """Byte-level CLIP tokenizer (no merges): 514-entry vocab of byte symbols
+    + SOT/EOT, every word split into its byte</w> sequence.
+
+    This is the zero-asset fallback — the exact CLIP framing and special
+    tokens, but each character costs one token, so only short instructions
+    fit in 77 (Language-Table's longest grammar strings do). For parity with
+    public CLIP checkpoints load the real merges via `from_bpe_file`.
+    """
+    return ClipBPETokenizer(merges=[], context_length=context_length)

@@ -43,6 +43,16 @@ def get_config():
     config.model.lava.lang_encoder = "embedding_in_obs"
     config.model.lava.dense_resnet_width = 256
     config.model.lava.dense_resnet_num_blocks = 8
+    # In-graph CLIP text tower dims (lang_encoder == "clip"). Defaults match
+    # the byte-level `clip_bpe.default_tokenizer` vocab (514); for public
+    # OpenAI weights use vocab 49408 / width 512 / 12 layers / 8 heads and
+    # the real merges file.
+    config.model.lava.text_vocab = 514
+    config.model.lava.text_context = 77
+    config.model.lava.text_width = 512
+    config.model.lava.text_layers = 12
+    config.model.lava.text_heads = 8
+    config.model.lava.text_embed_dim = 512
 
     # Data.
     config.data = ml_collections.ConfigDict()
@@ -54,6 +64,9 @@ def get_config():
     # (tf.data-service-distributable); "numpy": dependency-free iterator.
     config.data.loader = "tf"
     config.data.shuffle_buffer = 2048
+    # Emit "instruction_tokenized_clip" observations (CLIP BPE over the
+    # stored instruction text) for the LAVA "clip" language encoder.
+    config.data.clip_tokens = False
     # tf.data service endpoint for distributed preprocessing with the
     # "rlds_tf" loader (reference input_pipeline_rlds.py:307-317); None =
     # process batches locally.

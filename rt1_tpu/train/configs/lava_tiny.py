@@ -20,6 +20,10 @@ def get_config():
     config.model.lava.dense_resnet_width = 32
     config.model.lava.dense_resnet_num_blocks = 1
     config.model.lava.num_heads = 2
+    config.model.lava.text_width = 16
+    config.model.lava.text_layers = 2
+    config.model.lava.text_heads = 2
+    config.model.lava.text_embed_dim = 16
     # 64x64 divides cleanly through the 5-level conv-maxpool pyramid.
     config.data.height = 64
     config.data.width = 64

@@ -49,6 +49,21 @@ def build_model(model_config):
             num_tokens=model_config.num_image_tokens,
             emb=model_config.token_embedding_size,
         )
+    elif model_config.image_tokenizer == "efficientnet_small":
+        # Same FiLM-EfficientNet + TokenLearner family at ~0.35/0.35 scaling:
+        # spatially faithful but CPU-trainable (the flagship B3 needs a TPU).
+        from rt1_tpu.models.image_tokenizer import RT1ImageTokenizer
+
+        tokenizer_def = RT1ImageTokenizer(
+            embedding_output_dim=model_config.token_embedding_size,
+            use_token_learner=model_config.use_token_learner,
+            num_tokens=model_config.num_image_tokens,
+            width_coefficient=0.35,
+            depth_coefficient=0.35,
+            dtype=jnp.bfloat16
+            if model_config.dtype == "bfloat16"
+            else jnp.float32,
+        )
     return RT1Policy(
         action_space=language_table_action_space(),
         vocab_size=model_config.vocab_size,
@@ -88,6 +103,18 @@ def build_family(model_config):
         from rt1_tpu.trainer.bc import adapt_obs_for_lava, make_bc_step_loss_fn
 
         lv = model_config.lava
+        text_encoder_def = None
+        if lv.lang_encoder == "clip":
+            from rt1_tpu.models.lava.clip_text import CLIPTextEncoder
+
+            text_encoder_def = CLIPTextEncoder(
+                vocab_size=lv.get("text_vocab", 514),
+                context_length=lv.get("text_context", 77),
+                width=lv.get("text_width", 512),
+                num_layers=lv.get("text_layers", 12),
+                num_heads=lv.get("text_heads", 8),
+                embed_dim=lv.get("text_embed_dim", 512),
+            )
         model = SequenceLAVMSE(
             action_size=lv.action_size,
             dense_resnet_width=lv.dense_resnet_width,
@@ -100,6 +127,7 @@ def build_family(model_config):
             lava_pyramid_fuse_layers=tuple(lv.pyramid_fuse_layers),
             lava_image_encoder=lv.image_encoder,
             lava_lang_encoder=lv.lang_encoder,
+            text_encoder_def=text_encoder_def,
         )
 
         def init_fn(model, rng, obs, actions):
@@ -109,6 +137,28 @@ def build_family(model_config):
 
         return model, init_fn, make_bc_step_loss_fn(model)
     raise ValueError(f"Unknown model family: {family!r}")
+
+
+def _check_clip_token_config(config):
+    """Fail at the config seam, not steps later inside a traced forward:
+    the LAVA "clip" encoder consumes `instruction_tokenized_clip`, which
+    only `data.clip_tokens=True` produces — and producing it for any other
+    encoder ships a dead (window, 77) tensor to the device every step."""
+    clip_tokens = config.data.get("clip_tokens", False)
+    lava_clip = (
+        config.model.get("family", "rt1") == "lava"
+        and config.model.lava.lang_encoder == "clip"
+    )
+    if lava_clip and not clip_tokens:
+        raise ValueError(
+            "model.lava.lang_encoder='clip' requires data.clip_tokens=True "
+            "(the pipeline must emit instruction_tokenized_clip)"
+        )
+    if clip_tokens and not lava_clip:
+        raise ValueError(
+            "data.clip_tokens=True but no model consumes "
+            "instruction_tokenized_clip (set model.lava.lang_encoder='clip')"
+        )
 
 
 def synthetic_batches(config, seed=0) -> Iterator:
@@ -146,6 +196,11 @@ def dataset_batches(config, split="train") -> Iterator:
         raise FileNotFoundError(
             f"No episodes under {config.data.data_dir}/{split}"
         )
+    if config.data.get("clip_tokens", False) and config.data.loader == "rlds_tf":
+        raise ValueError(
+            "clip_tokens requires the windowed loaders ('tf' or 'numpy'); "
+            "the rlds_tf graph pipeline does not tokenize instructions"
+        )
     if config.data.loader == "rlds_tf":
         # Pure-TF windowing pipeline: episodes stream lazily from the npz
         # store (one read per generator pull, bounded host memory) into the
@@ -176,12 +231,18 @@ def dataset_batches(config, split="train") -> Iterator:
         )
         return iter(tfds.as_numpy_iterator())
 
+    clip_tokenizer = None
+    if config.data.get("clip_tokens", False):
+        from rt1_tpu.text.clip_bpe import default_tokenizer
+
+        clip_tokenizer = default_tokenizer()
     ds = WindowedEpisodeDataset(
         paths,
         window=config.model.time_sequence_length,
         crop_factor=config.data.crop_factor,
         height=config.data.height,
         width=config.data.width,
+        clip_tokenizer=clip_tokenizer,
     )
     if config.data.loader == "tf":
         tfds = ds.as_tf_dataset(
@@ -205,6 +266,7 @@ def train_and_evaluate(config, workdir: str):
     writer = create_writer(workdir)
     write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
 
+    _check_clip_token_config(config)
     model, init_fn, loss_fn = build_family(config.model)
     mesh = make_mesh(
         MeshConfig(

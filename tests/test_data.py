@@ -186,3 +186,61 @@ def test_prefetch_to_device_order_and_drain(episode_dir):
     # depth larger than the stream still drains completely.
     out = list(prefetch_to_device(iter(batches[:2]), sharding, depth=8))
     assert len(out) == 2
+
+
+def test_instruction_text_roundtrip(tmp_path, np_rng):
+    from rt1_tpu.data.episodes import (
+        decode_instruction_text,
+        encode_instruction_text,
+    )
+
+    ep = generate_synthetic_episode(np_rng, num_steps=4, height=16, width=16)
+    ep["instruction_text"] = encode_instruction_text("push the red moon")
+    p = str(tmp_path / "e.npz")
+    save_episode(p, ep)
+    back = load_episode(p)  # native reader handles the uint8 bytes member
+    assert decode_instruction_text(back["instruction_text"]) == "push the red moon"
+
+
+def test_clip_tokenized_windows(tmp_path, np_rng):
+    from rt1_tpu.data.episodes import encode_instruction_text
+    from rt1_tpu.text.clip_bpe import default_tokenizer
+
+    texts = ["push the red moon", "slide the blue cube left"]
+    paths = []
+    for i, text in enumerate(texts):
+        ep = generate_synthetic_episode(np_rng, num_steps=4, height=16, width=24)
+        ep["instruction_text"] = encode_instruction_text(text)
+        p = str(tmp_path / f"episode_{i}.npz")
+        save_episode(p, ep)
+        paths.append(p)
+
+    tok = default_tokenizer()
+    ds = WindowedEpisodeDataset(
+        paths, window=3, height=16, width=24, clip_tokenizer=tok
+    )
+    s = ds.get_window(0, np_rng)
+    tokens = s["observations"]["instruction_tokenized_clip"]
+    assert tokens.shape == (3, tok.context_length)
+    assert tokens.dtype == np.int32
+    # Constant along the window; equals direct tokenization.
+    np.testing.assert_array_equal(tokens[0], tokens[1])
+    np.testing.assert_array_equal(tokens[0], tok.tokenize_text(texts[0])[0])
+
+    # tf loader carries the extra observation with a static shape.
+    tf = pytest.importorskip("tensorflow")
+    tfds = ds.as_tf_dataset(batch_size=2, num_parallel_calls=2)
+    batch = next(iter(tfds))
+    assert batch["observations"]["instruction_tokenized_clip"].shape == (
+        2, 3, tok.context_length
+    )
+
+    # Pre-text episodes fail loudly, not silently.
+    ep = generate_synthetic_episode(np_rng, num_steps=4, height=16, width=24)
+    p_old = str(tmp_path / "episode_old.npz")
+    save_episode(p_old, ep)
+    ds_old = WindowedEpisodeDataset(
+        [p_old], window=3, height=16, width=24, clip_tokenizer=tok
+    )
+    with pytest.raises(KeyError, match="instruction_text"):
+        ds_old.get_window(0, np_rng)

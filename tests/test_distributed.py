@@ -44,9 +44,15 @@ def test_two_process_distributed(tmp_path):
         for i in range(2)
     ]
     outputs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=280)
-        outputs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outputs.append(out)
+    finally:
+        for p in procs:  # no leaked workers holding the coordinator port
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert os.path.exists(tmp_path / f"ok_{i}")

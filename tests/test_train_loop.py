@@ -61,6 +61,41 @@ def test_train_loop_lava_family_and_resume(tmp_path):
         np.testing.assert_allclose(a, b)
 
 
+def test_collect_then_train_lava_clip(tmp_path):
+    """Full LAVA-with-CLIP lifecycle: oracle demos (instruction text stored)
+    -> windowed pipeline emitting CLIP BPE tokens -> in-graph text tower.
+    The reference's Stack B 'clip' path (`networks/lava.py:425-435`) end to
+    end in one train command."""
+    from rt1_tpu.data.collect import collect_dataset
+    from rt1_tpu.envs import blocks
+    from rt1_tpu.train.configs import lava_tiny
+    from rt1_tpu.train.train import train_and_evaluate
+
+    data_dir = str(tmp_path / "data")
+    collect_dataset(
+        data_dir,
+        2,
+        block_mode=blocks.BlockMode.BLOCK_4,
+        seed=1,
+        max_steps=120,
+        image_hw=(64, 64),
+        progress_every=0,
+        splits=(("train", 1.0),),
+    )
+
+    config = lava_tiny.get_config()
+    config.num_steps = 2
+    config.checkpoint_every_steps = 2
+    config.per_host_batch_size = 8
+    config.data.data_dir = data_dir
+    config.data.loader = "numpy"
+    config.data.clip_tokens = True
+    config.model.lava.lang_encoder = "clip"
+    state = train_and_evaluate(config, str(tmp_path / "run"))
+    assert int(state.step) == 2
+    assert "text_encoder" in state.params["encoder"]
+
+
 def test_checkpoint_manager_roundtrip(tmp_path):
     from rt1_tpu.trainer.checkpoints import (
         CheckpointConfig,
