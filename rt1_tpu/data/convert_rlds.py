@@ -41,6 +41,7 @@ def episode_from_rlds(rlds_episode, embed_fn) -> Optional[dict]:
     """One RLDS episode -> our episode dict (None if empty)."""
     actions, firsts, terminals, rgbs, embeds = [], [], [], [], []
     cached_embedding = None
+    text = ""
     for step in rlds_episode["steps"].as_numpy_iterator():
         obs = step["observation"]
         text = decode_instruction_bytes(obs["instruction"])
@@ -55,12 +56,17 @@ def episode_from_rlds(rlds_episode, embed_fn) -> Optional[dict]:
         embeds.append(cached_embedding)
     if not actions:
         return None
+    from rt1_tpu.data.episodes import encode_instruction_text
+
     return {
         "action": np.stack(actions),
         "is_first": np.array(firsts),
         "is_terminal": np.array(terminals),
         "rgb": np.stack(rgbs),
         "instruction": np.stack(embeds),
+        # Raw text survives conversion: enables re-embedding and in-pipeline
+        # CLIP tokenization on real-robot RLDS data (not just oracle demos).
+        "instruction_text": encode_instruction_text(text),
     }
 
 

@@ -20,22 +20,28 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libepisode_reader.so")
+_WS_LIB_PATH = os.path.join(_NATIVE_DIR, "libwindow_sampler.so")
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
 
+_ws_lib = None
+_ws_lock = threading.Lock()
+_ws_build_failed = False
 
-def _build() -> bool:
-    """Ensure the library exists and is current; compile when needed.
+
+def _build_lib(source: str, lib_path: str, link_flags=()) -> bool:
+    """Ensure `lib_path` exists and is newer than `source`; compile if not.
 
     The freshness check runs BEFORE any write (a read-only install with a
     prebuilt current .so must work). Compilation happens under an flock so
     racing worker processes serialize, to a temp name atomically renamed so
-    no process ever dlopens (or has mapped) a half-written .so. The command
-    mirrors native/Makefile (kept for manual/dev builds).
+    no process ever dlopens (or has mapped) a half-written .so. The commands
+    mirror native/Makefile (kept for manual/dev builds).
     """
-    if os.path.exists(_LIB_PATH) and not _source_newer():
+    src_path = os.path.join(_NATIVE_DIR, source)
+    if os.path.exists(lib_path) and not _source_newer(src_path, lib_path):
         return True
     try:
         import fcntl
@@ -45,19 +51,19 @@ def _build() -> bool:
             fcntl.flock(lock_f, fcntl.LOCK_EX)
             try:
                 # Re-check under the lock: another process may have built.
-                if not os.path.exists(_LIB_PATH) or _source_newer():
-                    tmp = _LIB_PATH + f".tmp.{os.getpid()}"
+                if not os.path.exists(lib_path) or _source_newer(
+                    src_path, lib_path
+                ):
+                    tmp = lib_path + f".tmp.{os.getpid()}"
                     subprocess.run(
                         [
                             "g++", "-O2", "-std=c++17", "-fPIC", "-Wall",
-                            "-shared",
-                            os.path.join(_NATIVE_DIR, "episode_reader.cc"),
-                            "-lz", "-o", tmp,
+                            "-shared", src_path, *link_flags, "-o", tmp,
                         ],
                         check=True,
                         capture_output=True,
                     )
-                    os.replace(tmp, _LIB_PATH)
+                    os.replace(tmp, lib_path)
             finally:
                 fcntl.flock(lock_f, fcntl.LOCK_UN)
         return True
@@ -65,11 +71,14 @@ def _build() -> bool:
         return False
 
 
-def _source_newer() -> bool:
-    """Rebuild when episode_reader.cc is newer than the built library."""
-    src = os.path.join(_NATIVE_DIR, "episode_reader.cc")
+def _build() -> bool:
+    return _build_lib("episode_reader.cc", _LIB_PATH, ("-lz",))
+
+
+def _source_newer(src: str, lib_path: str) -> bool:
+    """Rebuild when the source is newer than the built library."""
     try:
-        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        return os.path.getmtime(src) > os.path.getmtime(lib_path)
     except OSError:
         return True
 
@@ -114,6 +123,77 @@ def get_library() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return get_library() is not None
+
+
+def get_window_sampler() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native window sampler; None if n/a."""
+    global _ws_lib, _ws_build_failed
+    with _ws_lock:
+        if _ws_lib is not None:
+            return _ws_lib
+        if _ws_build_failed:
+            return None
+        if not _build_lib("window_sampler.cc", _WS_LIB_PATH, ("-lpthread",)):
+            _ws_build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_WS_LIB_PATH)
+        except OSError:
+            _ws_build_failed = True
+            return None
+        lib.ws_crop_resize_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        _ws_lib = lib
+        return _ws_lib
+
+
+def sampler_available() -> bool:
+    return (
+        not os.environ.get("RT1_TPU_NO_NATIVE")
+        and get_window_sampler() is not None
+    )
+
+
+def crop_resize_batch(
+    frames, boxes, out_h: int, out_w: int, threads: int = 0
+) -> np.ndarray:
+    """Crop+bilinear-resize a batch of frames in C++ (GIL-free, threaded).
+
+    frames: sequence of (h, w, 3) uint8 arrays, all the same shape;
+    boxes: (n, 4) int32 (top, left, crop_h, crop_w) per frame.
+    Returns (n, out_h, out_w, 3) uint8. Matches cv2.INTER_LINEAR
+    half-pixel-center semantics to +/-1 LSB.
+    """
+    lib = get_window_sampler()
+    if lib is None:
+        raise RuntimeError("native window sampler unavailable")
+    n = len(frames)
+    frames = [np.ascontiguousarray(f, np.uint8) for f in frames]
+    h, w = frames[0].shape[:2]
+    ptrs = (ctypes.c_void_p * n)(*[f.ctypes.data for f in frames])
+    boxes_arr = np.ascontiguousarray(boxes, np.int32)
+    out = np.empty((n, out_h, out_w, 3), np.uint8)
+    lib.ws_crop_resize_batch(
+        ptrs,
+        boxes_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        h,
+        w,
+        out.ctypes.data_as(ctypes.c_void_p),
+        out_h,
+        out_w,
+        threads or (os.cpu_count() or 1),
+    )
+    return out
 
 
 _DTYPES = {

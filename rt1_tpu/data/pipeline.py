@@ -116,21 +116,21 @@ class WindowedEpisodeDataset:
         ep = self._episode(ep_i)
         rng = rng or np.random.default_rng()
 
-        images, embeds, actions, terms = [], [], [], []
+        frames, embeds, actions, terms = [], [], [], []
+        boxes = []
         for j in range(start, start + self.window):
             rgb = self._padded_step(ep, j, "rgb")
-            images.append(
-                _random_crop_resize(
-                    rgb, self.crop_factor, self.height, self.width, rng,
-                    dtype=self.image_dtype,
-                )
+            frames.append(rgb)
+            boxes.append(
+                _crop_box(rgb.shape[0], rgb.shape[1], self.crop_factor, rng)
             )
             embeds.append(self._padded_step(ep, j, "instruction"))
             actions.append(self._padded_step(ep, j, "action"))
             terms.append(np.int32(bool(self._padded_step(ep, j, "is_terminal"))))
+        images = self._crop_resize_frames(frames, boxes)
 
         observations = {
-            "image": np.stack(images),
+            "image": images,
             "natural_language_embedding": np.stack(embeds).astype(np.float32),
         }
         if self._clip_tokenizer is not None:
@@ -145,6 +145,59 @@ class WindowedEpisodeDataset:
                 "action": np.stack(actions).astype(np.float32),
             },
         }
+
+    def _crop_resize_frames(self, frames, boxes) -> np.ndarray:
+        """(window,) frames + crop boxes -> (window, H, W, 3) in image_dtype.
+
+        cv2 (SIMD bilinear, GIL-released) when importable; otherwise the
+        native C++ sampler (native/window_sampler.cc) keeps the pipeline
+        dependency-free. Both follow cv2.INTER_LINEAR half-pixel-center
+        semantics, so the sample distribution matches to +/-1 LSB.
+        Set RT1_TPU_FORCE_NATIVE_SAMPLER=1 to force the native path.
+        """
+        import os
+
+        use_native = bool(os.environ.get("RT1_TPU_FORCE_NATIVE_SAMPLER"))
+        if use_native and frames[0].dtype != np.uint8:
+            raise RuntimeError(
+                "RT1_TPU_FORCE_NATIVE_SAMPLER: the native sampler only "
+                f"handles uint8 frames, got {frames[0].dtype}"
+            )
+        if not use_native:
+            try:
+                import cv2  # noqa: F401
+            except ImportError:
+                if frames[0].dtype != np.uint8:
+                    raise RuntimeError(
+                        "cv2 is unavailable and the native sampler only "
+                        f"handles uint8 frames, got {frames[0].dtype}; "
+                        "install opencv-python"
+                    ) from None
+                use_native = True
+        if use_native:
+            from rt1_tpu.data import native
+
+            if not native.sampler_available():
+                raise RuntimeError(
+                    "Neither cv2 nor the native window sampler is available "
+                    "(build native/ with `make` or install opencv-python)"
+                )
+            # Threads=1: tf.data's parallel map already fans out across
+            # windows; the call releases the GIL so those threads genuinely
+            # run in parallel.
+            out = native.crop_resize_batch(
+                frames, boxes, self.height, self.width, threads=1
+            )
+        else:
+            out = np.stack(
+                [
+                    _cv2_crop_resize(rgb, box, self.height, self.width)
+                    for rgb, box in zip(frames, boxes)
+                ]
+            )
+        if self.image_dtype == "float32":
+            return out.astype(np.float32) / 255.0
+        return out
 
     def _episode_clip_tokens(self, ep_i: int) -> np.ndarray:
         """(context,) int32 CLIP BPE frame for the episode's instruction."""
@@ -258,31 +311,28 @@ class WindowedEpisodeDataset:
         return ds.prefetch(tf.data.AUTOTUNE)
 
 
-def _random_crop_resize(
-    rgb: np.ndarray,
-    crop_factor: Optional[float],
-    height: int,
-    width: int,
-    rng: np.random.Generator,
-    dtype: str = "uint8",
-) -> np.ndarray:
-    """`DecodeAndRandomResizedCrop` parity (load_np_dataset.py:8-39): crop a
-    `crop_factor` box at a uniform random offset, bilinear-resize to
-    (height, width). cv2 instead of PIL (≈5× faster). dtype="uint8" keeps
-    the reference's on-host representation (PIL resizes uint8) and ships 4x
-    fewer bytes to the device; "float32" scales to [0,1] on host."""
+def _crop_box(
+    h: int, w: int, crop_factor: Optional[float], rng: np.random.Generator
+) -> Tuple[int, int, int, int]:
+    """(top, left, crop_h, crop_w) — `DecodeAndRandomResizedCrop` parity
+    (load_np_dataset.py:8-39): a `crop_factor` box at a uniform random
+    offset (the full frame when crop_factor is None)."""
+    if crop_factor is None:
+        return 0, 0, h, w
+    ch, cw = int(h * crop_factor), int(w * crop_factor)
+    top = int(rng.integers(0, h - ch + 1))
+    left = int(rng.integers(0, w - cw + 1))
+    return top, left, ch, cw
+
+
+def _cv2_crop_resize(rgb: np.ndarray, box, height: int, width: int) -> np.ndarray:
+    """Single-frame crop + cv2.INTER_LINEAR resize (`DecodeAndRandomResizedCrop`
+    parity, load_np_dataset.py:8-39); dtype preserved (uint8 in, uint8 out)."""
     import cv2
 
-    h, w = rgb.shape[:2]
-    if crop_factor is not None:
-        ch, cw = int(h * crop_factor), int(w * crop_factor)
-        top = int(rng.integers(0, h - ch + 1))
-        left = int(rng.integers(0, w - cw + 1))
-        rgb = rgb[top : top + ch, left : left + cw]
-    out = cv2.resize(rgb, (width, height), interpolation=cv2.INTER_LINEAR)
-    if dtype == "uint8":
-        return out  # cv2 preserves uint8; model converts on device
-    return out.astype(np.float32) / 255.0
+    top, left, ch, cw = box
+    crop = rgb[top : top + ch, left : left + cw]
+    return cv2.resize(crop, (width, height), interpolation=cv2.INTER_LINEAR)
 
 
 def _stack_tree(samples: List[Dict]) -> Dict:

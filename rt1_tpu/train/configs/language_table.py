@@ -67,6 +67,10 @@ def get_config():
     # Emit "instruction_tokenized_clip" observations (CLIP BPE over the
     # stored instruction text) for the LAVA "clip" language encoder.
     config.data.clip_tokens = False
+    # Path to CLIP's bpe_simple_vocab_16e6.txt(.gz) merges. None -> the
+    # byte-level fallback tokenizer (model.lava.text_vocab must then be 514;
+    # with the real merges use 49408).
+    config.data.clip_bpe_path = ml_collections.config_dict.placeholder(str)
     # tf.data service endpoint for distributed preprocessing with the
     # "rlds_tf" loader (reference input_pipeline_rlds.py:307-317); None =
     # process batches locally.

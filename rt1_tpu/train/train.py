@@ -139,6 +139,34 @@ def build_family(model_config):
     raise ValueError(f"Unknown model family: {family!r}")
 
 
+def _make_clip_tokenizer(config):
+    """Tokenizer matching the text tower's config, validated at the seam.
+
+    `data.clip_bpe_path` loads the real CLIP merges (vocab 49408);
+    unset uses the byte-level fallback (vocab 514). Context length and
+    vocab must agree with `model.lava.text_context` / `text_vocab`, or the
+    Embed gather clamps out-of-range ids / the posemb slice shape-fails —
+    deep inside the traced step instead of here.
+    """
+    from rt1_tpu.text.clip_bpe import ClipBPETokenizer, default_tokenizer
+
+    lv = config.model.lava
+    context = lv.get("text_context", 77)
+    path = config.data.get("clip_bpe_path")
+    if path:
+        tokenizer = ClipBPETokenizer.from_bpe_file(path, context_length=context)
+    else:
+        tokenizer = default_tokenizer(context_length=context)
+    vocab = len(tokenizer.encoder)
+    if vocab != lv.get("text_vocab", 514):
+        raise ValueError(
+            f"model.lava.text_vocab={lv.get('text_vocab')} but the "
+            f"tokenizer ({'merges file' if path else 'byte-level default'}) "
+            f"has vocab {vocab}; set text_vocab={vocab}"
+        )
+    return tokenizer
+
+
 def _check_clip_token_config(config):
     """Fail at the config seam, not steps later inside a traced forward:
     the LAVA "clip" encoder consumes `instruction_tokenized_clip`, which
@@ -233,9 +261,7 @@ def dataset_batches(config, split="train") -> Iterator:
 
     clip_tokenizer = None
     if config.data.get("clip_tokens", False):
-        from rt1_tpu.text.clip_bpe import default_tokenizer
-
-        clip_tokenizer = default_tokenizer()
+        clip_tokenizer = _make_clip_tokenizer(config)
     ds = WindowedEpisodeDataset(
         paths,
         window=config.model.time_sequence_length,

@@ -27,7 +27,9 @@ def _make(spec):
             return make_backend(
                 "pybullet", asset_root=os.environ.get("LT_ASSET_ROOT")
             )
-        except Exception as e:
+        except (ValueError, FileNotFoundError, OSError) as e:
+            # Expected unavailability (no asset root / missing URDFs) only —
+            # genuine backend regressions must fail, not skip.
             pytest.skip(f"pybullet backend unavailable: {e}")
     return make_backend(spec)
 

@@ -85,3 +85,46 @@ def test_native_large_random_roundtrip(lib_available, tmp_path):
     got = native.load_episode_native(path)
     for k, v in data.items():
         np.testing.assert_array_equal(got[k], v)
+
+
+def test_native_window_sampler_matches_cv2(tmp_path, monkeypatch):
+    """The C++ crop+bilinear matches cv2.INTER_LINEAR to +/-1 LSB, and the
+    pipeline produces the same sample distribution through either path."""
+    cv2 = pytest.importorskip("cv2")
+    from rt1_tpu.data import native
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+    from rt1_tpu.data.pipeline import WindowedEpisodeDataset
+
+    if not native.sampler_available():
+        pytest.skip("native window sampler not built")
+
+    rng = np.random.default_rng(3)
+    frames = [rng.integers(0, 256, (90, 160, 3), np.uint8) for _ in range(4)]
+    boxes = np.array([[2, 5, 85, 152], [0, 0, 90, 160],
+                      [4, 3, 85, 152], [1, 7, 85, 152]], np.int32)
+    out = native.crop_resize_batch(frames, boxes, 64, 112)
+    ref = np.stack([
+        cv2.resize(
+            f[t : t + ch, l : l + cw], (112, 64),
+            interpolation=cv2.INTER_LINEAR,
+        )
+        for f, (t, l, ch, cw) in zip(frames, boxes)
+    ])
+    assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+
+    # Same pipeline sample through the forced-native path vs the cv2 path.
+    ep = generate_synthetic_episode(rng, num_steps=5, height=90, width=160)
+    p = str(tmp_path / "episode_0.npz")
+    save_episode(p, ep)
+    ds = WindowedEpisodeDataset([p], window=3, crop_factor=0.95,
+                                height=64, width=112)
+    monkeypatch.delenv("RT1_TPU_FORCE_NATIVE_SAMPLER", raising=False)
+    s_cv2 = ds.get_window(2, np.random.default_rng(11))
+    monkeypatch.setenv("RT1_TPU_FORCE_NATIVE_SAMPLER", "1")
+    s_nat = ds.get_window(2, np.random.default_rng(11))
+    a = s_cv2["observations"]["image"].astype(int)
+    b = s_nat["observations"]["image"].astype(int)
+    assert np.abs(a - b).max() <= 1
+    np.testing.assert_array_equal(
+        s_cv2["actions"]["action"], s_nat["actions"]["action"]
+    )
