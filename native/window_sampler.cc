@@ -11,7 +11,7 @@
 // Resize convention matches cv2.INTER_LINEAR / TF half-pixel centers:
 //   src = (dst + 0.5) * (in/out) - 0.5, edge-clamped,
 // so the native path is a drop-in for the cv2 implementation in
-// rt1_tpu/data/pipeline.py::_random_crop_resize (equivalence tested to
+// rt1_tpu/data/pipeline.py::_cv2_crop_resize (equivalence tested to
 // +/-1 LSB in tests/test_native_reader.py).
 //
 // Build: g++ -O2 -shared -fPIC -std=c++17 window_sampler.cc -lpthread
@@ -57,9 +57,10 @@ void crop_resize_one(const uint8_t* frame, int h, int w, int top, int left,
                      const std::vector<XCoef>& yc) {
   const uint8_t* src = frame + (static_cast<int64_t>(top) * w + left) * 3;
   const int src_stride = w * 3;
-  // Row buffers: horizontal pass result for the two source rows feeding the
-  // current output row, in 16-bit fixed point (value << kShift fits 19 bits,
-  // we keep it at 16 by pre-shifting down 3; final rounding absorbs it).
+  // Row buffers: horizontal-pass results for the two source rows feeding
+  // the current output row, as int32 fixed point (8-bit pixel x 11-bit
+  // weight sum fits 19 bits); the vertical pass widens to int64 before the
+  // 2*kShift rounding shift.
   std::vector<int32_t> row0(out_w * 3), row1(out_w * 3);
   int cached_y0 = -1, cached_y1 = -1;
 

@@ -44,10 +44,12 @@ def episode_from_rlds(rlds_episode, embed_fn) -> Optional[dict]:
     text = ""
     for step in rlds_episode["steps"].as_numpy_iterator():
         obs = step["observation"]
-        text = decode_instruction_bytes(obs["instruction"])
         if cached_embedding is None:
             # One instruction per episode; embed once
-            # (reference embeds per step, same value each time).
+            # (reference embeds per step, same value each time). The stored
+            # text is captured at the SAME step, so it can never diverge
+            # from the embedding.
+            text = decode_instruction_bytes(obs["instruction"])
             cached_embedding = np.asarray(embed_fn(text), np.float32)
         actions.append(np.asarray(step["action"], np.float32))
         firsts.append(bool(step["is_first"]))

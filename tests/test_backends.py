@@ -20,14 +20,14 @@ def _make(spec):
     from rt1_tpu.envs.backends import make_backend
 
     if spec == "pybullet":
-        pytest.importorskip("pybullet")
+        pb = pytest.importorskip("pybullet")
         # The URDF asset tree isn't bundled; point LT_ASSET_ROOT at one to
         # run the contract suite against real PyBullet.
         try:
             return make_backend(
                 "pybullet", asset_root=os.environ.get("LT_ASSET_ROOT")
             )
-        except (ValueError, FileNotFoundError, OSError) as e:
+        except (ValueError, FileNotFoundError, OSError, pb.error) as e:
             # Expected unavailability (no asset root / missing URDFs) only —
             # genuine backend regressions must fail, not skip.
             pytest.skip(f"pybullet backend unavailable: {e}")

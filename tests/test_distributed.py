@@ -65,3 +65,11 @@ def test_two_process_distributed(tmp_path):
     assert stripes[0].isdisjoint(stripes[1])
     total = len(stripes[0] | stripes[1])
     assert total == 18  # 3 episodes x 6 steps = 18 windows
+
+    # Both hosts computed the SAME global losses: the gradient reduction over
+    # the cross-host data axis is a real collective, not per-host math.
+    with open(tmp_path / "loss_0.txt") as f:
+        l0 = f.read()
+    with open(tmp_path / "loss_1.txt") as f:
+        l1 = f.read()
+    assert l0 == l1 and l0
