@@ -5,6 +5,7 @@ The N_CHOOSE_K train/test split must reproduce the reference's seeded shuffle
 (`blocks.py:120-129`) bit-for-bit so dataset/eval splits line up.
 """
 
+import collections
 import enum
 import itertools
 
@@ -98,6 +99,35 @@ def text_descriptions(mode):
 def block_pairs(mode):
     """All ordered pairs of distinct blocks (for instruction enumeration)."""
     return itertools.permutations(block_set(mode), 2)
+
+
+def synonym_groups(mode):
+    """Per-block referring-expression variants, unioned over board states.
+
+    `language.block_synonyms` admits a bare color ('red block') or bare
+    shape ('star') only when unique on the current board; this returns, per
+    block, every variant that is valid on SOME reachable board of `mode`.
+    Fixed boards (BLOCK_4/8, ±pole) always show the full set, so a bare
+    form is reachable iff the color/shape is unique in the set — which
+    includes e.g. the pole on BLOCK_8_WPOLE. N_CHOOSE_K boards are
+    subsets, so any bare form can become unique. Order matches
+    block_synonyms (color, shape, canonical).
+    """
+    names = block_set(mode)
+    color_counts = collections.Counter(color_shape(b)[0] for b in names)
+    shape_counts = collections.Counter(color_shape(b)[1] for b in names)
+    any_subset = mode == BlockMode.N_CHOOSE_K
+    groups = []
+    for b in names:
+        color, shape = color_shape(b)
+        variants = []
+        if any_subset or color_counts[color] == 1:
+            variants.append(f"{color} block")
+        if any_subset or shape_counts[shape] == 1:
+            variants.append(shape)
+        variants.append(f"{color} {shape}")
+        groups.append(variants)
+    return groups
 
 
 def color_shape(block):

@@ -57,6 +57,56 @@ def generate_all_instructions(block_mode):
     )
 
 
+def generate_runtime_instructions(block_mode):
+    """Every instruction the reward SAMPLERS can emit at runtime.
+
+    `generate_all_instructions` mirrors the reference's enumeration, which
+    (faithfully) diverges from its own samplers in two ways: canonical
+    block names only (samplers draw from the per-board synonym space —
+    bare colors/shapes when unique), and 3-verb lists where samplers use
+    the generic 4-verb push list (block2location, corner; corner isn't
+    enumerated at all). Embedding tables built for closed-loop eval must
+    cover the sampler space, so this unions each family's
+    `runtime_instructions` (behaviorally pinned by
+    `tests/test_env_instructions.py`). The play family's BLOCK_8 generator
+    is open-ended and excluded; its fixed BLOCK_4 set is included.
+    """
+    from rt1_tpu.envs import blocks
+    from rt1_tpu.envs.rewards import (
+        block2block,
+        block2block_relative,
+        block2location,
+        block2relativelocation,
+        corner,
+        play,
+        point2block,
+        separate_blocks,
+    )
+
+    out = list(generate_all_instructions(block_mode))
+    seen = set(out)
+
+    def extend(items):
+        for s in items:
+            if s not in seen:
+                seen.add(s)
+                out.append(s)
+
+    for family in (
+        block2block,
+        point2block,
+        block2relativelocation,
+        block2location,
+        block2block_relative,
+        separate_blocks,
+        corner,
+    ):
+        extend(family.runtime_instructions(block_mode))
+    if block_mode == blocks.BlockMode.BLOCK_4:
+        extend(play.get_100_4block_instructions(num_train_per_family=20))
+    return out
+
+
 def vocab_size(block_mode):
     words = set()
     for instruction in generate_all_instructions(block_mode):

@@ -23,6 +23,21 @@ def generate_all_instructions(block_mode):
     return out
 
 
+def runtime_instructions(block_mode):
+    """Sampler-complete: every synonym pairing `_sample_instruction` can
+    emit (the parity enumeration above is canonical names only)."""
+    out = []
+    for g1, g2 in itertools.permutations(
+        blocks_module.synonym_groups(block_mode), 2
+    ):
+        for start_text in g1:
+            for target_text in g2:
+                for verb in language.PUSH_VERBS:
+                    for prep in language.PREPOSITIONS:
+                        out.append(f"{verb} {start_text} {prep} {target_text}")
+    return out
+
+
 class BlockToBlockReward(base.BoardReward):
     """Sparse reward when the start block reaches the target block."""
 

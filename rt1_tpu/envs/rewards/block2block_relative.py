@@ -124,6 +124,24 @@ def generate_all_instructions(block_mode):
     return out
 
 
+def runtime_instructions(block_mode):
+    """Sampler-complete: synonym pairs of distinct blocks (the sampler's
+    PUSH_VERBS is a subset of the enumeration VERBS, so VERBS covers it)."""
+    out = []
+    for g1, g2 in itertools.permutations(
+        blocks_module.synonym_groups(block_mode), 2
+    ):
+        for block_syn in g1:
+            for target_syn in g2:
+                for verb in VERBS:
+                    for direction in DIRECTIONS:
+                        for direction_syn in DIRECTION_SYNONYMS[direction]:
+                            out.append(
+                                f"{verb} {block_syn} {direction_syn} {target_syn}"
+                            )
+    return out
+
+
 class BlockToBlockRelativeLocationReward(base.BoardReward):
     """Sparse reward when block sits on the offset ray from the target block."""
 

@@ -94,14 +94,28 @@ VERBS = [
 ]
 
 
-def generate_all_instructions(block_mode):
+def generate_all_instructions(block_mode, verbs=None, names=None):
+    """Enumeration mirrors the reference's 3-verb list and canonical names
+    by default; `runtime_instructions` passes the sampler's actual spaces
+    (see `rewards.generate_runtime_instructions`)."""
     out = []
-    for block_text in blocks_module.text_descriptions(block_mode):
+    verbs = VERBS if verbs is None else verbs
+    if names is None:
+        names = blocks_module.text_descriptions(block_mode)
+    for block_text in names:
         for location in ABSOLUTE_LOCATIONS:
             for location_syn in LOCATION_SYNONYMS[location]:
-                for verb in VERBS:
+                for verb in verbs:
                     out.append(f"{verb} {block_text} to the {location_syn}")
     return out
+
+
+def runtime_instructions(block_mode):
+    """Sampler-complete: PUSH_VERBS (the sampler's list) x all synonyms."""
+    flat = [v for g in blocks_module.synonym_groups(block_mode) for v in g]
+    return generate_all_instructions(
+        block_mode, verbs=language.PUSH_VERBS, names=flat
+    )
 
 
 class BlockToAbsoluteLocationReward(base.BoardReward):

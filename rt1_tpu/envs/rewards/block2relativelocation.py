@@ -92,9 +92,20 @@ def sample_diagonal(rng, direction):
     return prep % (first_syn, second_syn)
 
 
-def generate_all_instructions(block_mode):
+def runtime_instructions(block_mode):
+    """Sampler-complete: all block synonym variants (same verb list — this
+    family samples from its own 3-verb VERBS, unlike block2location)."""
+    flat = [
+        v for g in blocks_module.synonym_groups(block_mode) for v in g
+    ]
+    return generate_all_instructions(block_mode, names=flat)
+
+
+def generate_all_instructions(block_mode, names=None):
     out = []
-    for block_text in blocks_module.text_descriptions(block_mode):
+    if names is None:
+        names = blocks_module.text_descriptions(block_mode)
+    for block_text in names:
         for verb in VERBS:
             for direction in DIRECTIONS:
                 if "diagonal" in direction:

@@ -19,6 +19,16 @@ def generate_all_instructions(block_mode):
     return out
 
 
+def runtime_instructions(block_mode):
+    """Sampler-complete: all block synonym variants, not just canonical."""
+    out = []
+    for group in blocks_module.synonym_groups(block_mode):
+        for block_text in group:
+            for prep in language.POINT_PREPOSITIONS:
+                out.append(f"{prep} {block_text}")
+    return out
+
+
 class PointToBlockReward(base.BoardReward):
     """Sparse reward when the effector reaches the chosen block."""
 

@@ -67,6 +67,41 @@ def generate_all_instructions(block_mode):
     return out
 
 
+def runtime_instructions(block_mode):
+    """Sampler-complete: avoid-lists are ordered tuples of OTHER blocks'
+    synonyms (sizes 1-3 rendered explicitly, 3 may also use a group
+    synonym, >= 4 always does) — the parity enumeration's name-prefix
+    orderings cover only a sliver of this. Quadratic-ish in board size;
+    intended for the BLOCK_4/BLOCK_8 table configs (N_CHOOSE_K's space is
+    astronomically large — use a string-level embedder there).
+    """
+    import itertools
+
+    groups = blocks_module.synonym_groups(block_mode)
+    out = []
+    for i, g in enumerate(groups):
+        others = [g2 for j, g2 in enumerate(groups) if j != i]
+        avoid_strs = list(GROUP_SYNONYMS)  # len 3 group branch and >= 4
+        for g2 in others:
+            avoid_strs.extend(g2)  # len 1
+        for ga, gb in itertools.permutations(others, 2):  # len 2, ordered
+            avoid_strs.extend(
+                f"{a} and {b}" for a in ga for b in gb
+            )
+        for ga, gb, gc in itertools.permutations(others, 3):  # len 3
+            avoid_strs.extend(
+                f"{a}, {b}, and {c}"
+                for a in ga
+                for b in gb
+                for c in gc
+            )
+        for block_syn in g:
+            for avoid_str in avoid_strs:
+                for template in SEPARATE_TEMPLATES:
+                    out.append(template % (block_syn, avoid_str))
+    return out
+
+
 class SeparateBlocksReward(base.BoardReward):
     """Push the most-crowded block away from its neighbors."""
 

@@ -117,8 +117,10 @@ class TableInstructionEmbedder:
             return self._table[text]
         except KeyError as e:
             raise KeyError(
-                f"Instruction not in embedding table: {text!r}. Regenerate "
-                "the table over rewards.generate_all_instructions(...)"
+                f"Instruction not in embedding table: {text!r}. Rebuild the "
+                "table over rewards.generate_runtime_instructions(...) — "
+                "`python -m rt1_tpu.eval.embedding --output table.npz` — "
+                "which covers the samplers' full synonym/verb space."
             ) from e
 
     @staticmethod
@@ -170,3 +172,51 @@ def get_embedder(spec="hash"):
     if spec.endswith(".npz"):
         return TableInstructionEmbedder(spec)
     raise ValueError(f"Unknown embedder spec: {spec}")
+
+
+def build_table_cli():
+    """CLI: precompute an embedding table over the full instruction grammar.
+
+    The production path from the module docstring made concrete: enumerate
+    every instruction the reward samplers can emit at runtime
+    (`rewards.generate_runtime_instructions` — a superset of the
+    reference-parity enumeration, covering the sampler/enumeration verb
+    divergences and the corner family), embed each with the chosen
+    provider, save as an .npz usable anywhere an embedder spec is accepted
+    (`--embedder /path/table.npz`). The play family's BLOCK_8 generator is
+    open-ended and not table-coverable — use a string-level provider
+    (ngram/hash/use) for it.
+
+      python -m rt1_tpu.eval.embedding --output /tmp/table.npz \\
+          --block_mode BLOCK_4 --embedder ngram
+    """
+    import argparse
+
+    from rt1_tpu.envs import blocks, rewards
+
+    parser = argparse.ArgumentParser(description=build_table_cli.__doc__)
+    parser.add_argument("--output", required=True, help="Output .npz path.")
+    parser.add_argument("--block_mode", default="BLOCK_8")
+    parser.add_argument(
+        "--embedder", default="ngram",
+        help="Provider to precompute with (hash | ngram | use).")
+    args = parser.parse_args()
+
+    mode = blocks.BlockMode(args.block_mode)
+    if mode == blocks.BlockMode.N_CHOOSE_K:
+        raise SystemExit(
+            "N_CHOOSE_K's runtime instruction space (16-block synonym "
+            "avoid-lists) is too large to table; use a string-level "
+            "embedder (ngram/hash/use) instead."
+        )
+    instructions = rewards.generate_runtime_instructions(mode)
+    embed_fn = get_embedder(args.embedder)
+    TableInstructionEmbedder.build(instructions, embed_fn, path=args.output)
+    print(
+        f"wrote {len(instructions)} instruction embeddings "
+        f"({args.embedder}, {args.block_mode}) to {args.output}"
+    )
+
+
+if __name__ == "__main__":
+    build_table_cli()

@@ -93,6 +93,7 @@ def main(argv):
             target_width=config.data.width,
             random_crop_factor=config.data.crop_factor,
             sequence_length=config.model.time_sequence_length,
+            backend=FLAGS.backend,
         ),
     )
     results["checkpoint_step"] = step
@@ -111,6 +112,10 @@ if __name__ == "__main__":
     flags.DEFINE_string("block_mode", "BLOCK_8", "Block variant.")
     flags.DEFINE_integer("seed", 0, "Env seed.")
     flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    flags.DEFINE_string(
+        "backend", "kinematic",
+        "Physics backend: kinematic | kinematic_arm (xArm6 IK in the "
+        "loop) | pybullet | auto.")
     flags.DEFINE_bool("videos", False, "Write episode videos.")
     flags.DEFINE_bool(
         "allow_embedder_mismatch", False,
