@@ -40,6 +40,9 @@ def main():
     p.add_argument(
         "--data_dir", default="/tmp/rt1_bench_episodes",
         help="e2e mode: episode cache dir (synthesized on first run).")
+    p.add_argument(
+        "--attention_impl", default="dense", choices=["dense", "pallas"],
+        help="infer mode: attention implementation under test.")
     args = p.parse_args()
 
     import jax
@@ -61,6 +64,7 @@ def main():
         action_space=language_table_action_space(),
         time_sequence_length=6,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        attention_impl=args.attention_impl,
     )
     rng = jax.random.PRNGKey(0)
     b, t = args.batch, 6
@@ -302,10 +306,12 @@ def infer_bench(args, model, rng, obs, actions):
     print(
         json.dumps(
             {
-                "metric": "infer_step_latency_p50",
+                "metric": f"infer_step_latency_p50_{args.attention_impl}",
                 "value": round(p50, 3),
                 "unit": "ms",
-                "vs_baseline": 1.0,
+                "vs_baseline": _vs_baseline(
+                    p50, f"infer_step_latency_p50_{args.attention_impl}"
+                ),
             }
         )
     )

@@ -74,9 +74,29 @@ def fused_attention(
     Returns (b, s, h, d), matching
     `rt1_tpu/parallel/ring_attention.py::dense_attention_reference`.
     """
-    b, s, h, d = q.shape
+    b, s_in, h, d_in = q.shape
     if scale is None:
-        scale = 1.0 / (d**0.5)
+        scale = 1.0 / (d_in**0.5)
+
+    # Mosaic tiles fp32 as (8, 128): pad sequence to a multiple of 8 and
+    # head_dim to a multiple of 128 so the kernel lowers on real TPUs (RT-1's
+    # s=66, d=64 is unaligned). Padding changes no real output: padded K/V
+    # columns are masked out of every real row, padded Q rows attend only to
+    # themselves (keeps their softmax finite) and are sliced away.
+    s = -(-s_in // 8) * 8
+    d = -(-d_in // 128) * 128
+    pad_sd = [(0, 0), (0, s - s_in), (0, 0), (0, d - d_in)]
+    if s != s_in or d != d_in:
+        q = jnp.pad(q, pad_sd)
+        k = jnp.pad(k, pad_sd)
+        v = jnp.pad(v, pad_sd)
+    if s != s_in:
+        # Zero-padded d columns need no masking (they add zeros to the
+        # logits); padded sequence positions do.
+        if mask is None:
+            mask = jnp.ones((s_in, s_in), jnp.int32)
+        mask = jnp.pad(mask.astype(jnp.int32), [(0, s - s_in), (0, s - s_in)])
+        mask = mask.at[jnp.arange(s_in, s), jnp.arange(s_in, s)].set(1)
 
     # One grid program per (batch, head): layout as (b*h, s, d).
     def to_bh(x):
@@ -107,4 +127,5 @@ def fused_attention(
         out_specs=qkv_spec,
         interpret=interpret,
     )(*args)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out[:, :s_in, :, :d_in]

@@ -41,9 +41,15 @@ flags.DEFINE_integer("eval_episodes", 20, "Closed-loop episodes per policy.")
 flags.DEFINE_string("stage", "all", "all | collect | train | eval")
 flags.DEFINE_string("block_mode", "BLOCK_4", "Board variant.")
 flags.DEFINE_string("embedder", "ngram", "Instruction embedder.")
+flags.DEFINE_enum(
+    "image_tokenizer", "efficientnet_b3",
+    ["efficientnet_b3", "efficientnet_small"],
+    "efficientnet_b3 (flagship, TPU) | efficientnet_small (CPU-trainable).")
+flags.DEFINE_integer("height", 128, "Train/eval image height.")
+flags.DEFINE_integer("width", 224, "Train/eval image width.")
+flags.DEFINE_integer("batch", 32, "Per-host batch size.")
 
 REWARD = "block2block"
-HEIGHT, WIDTH = 128, 224
 EVAL_SEED = 10_000  # disjoint from collection worker seeds (0..workers)
 
 
@@ -51,10 +57,11 @@ def get_train_config(data_dir, num_steps):
     from rt1_tpu.train.configs import language_table
 
     config = language_table.get_config()
+    config.model.image_tokenizer = FLAGS.image_tokenizer
     config.data.data_dir = data_dir
-    config.data.height = HEIGHT
-    config.data.width = WIDTH
-    config.per_host_batch_size = 32
+    config.data.height = FLAGS.height
+    config.data.width = FLAGS.width
+    config.per_host_batch_size = FLAGS.batch
     config.num_steps = num_steps
     # MultiStepLR milestones (50, 75, 90) "epochs" -> decay at 50/75/90% of
     # the run, reference schedule shape (distribute_train.py:283-287).
@@ -175,7 +182,8 @@ def _run_protocol(policy, tag):
         seed=EVAL_SEED,
         embedder=FLAGS.embedder,
         env_kwargs=dict(
-            target_height=HEIGHT, target_width=WIDTH, sequence_length=6
+            target_height=FLAGS.height, target_width=FLAGS.width,
+            sequence_length=6
         ),
     )
     successes = results["successes"][REWARD]
