@@ -37,8 +37,13 @@ def build_eval_env(
     random_crop_factor=0.95,
     sequence_length=6,
     backend="kinematic",
+    history_keys=None,
 ):
-    """The reference env chain (`main_rt1.py:130-142`), our wrappers."""
+    """The reference env chain (`main_rt1.py:130-142`), our wrappers.
+
+    `history_keys` extends/overrides the stacked observation keys (e.g.
+    include "instruction" for the LAVA clip-tokenizer policy).
+    """
     env = LanguageTable(
         block_mode=block_mode,
         reward_factory=rewards_module.get_reward_factory(reward_name),
@@ -52,11 +57,13 @@ def build_eval_env(
         target_width=target_width,
         random_crop_factor=random_crop_factor,
     )
+    if history_keys is None:
+        history_keys = (
+            "rgb_sequence", "natural_language_embedding",
+            "effector_translation", "effector_target_translation",
+        )
     env = HistoryWrapper(
-        env,
-        history_length=sequence_length,
-        keys=("rgb_sequence", "natural_language_embedding",
-              "effector_translation", "effector_target_translation"),
+        env, history_length=sequence_length, keys=tuple(history_keys)
     )
     return env
 

@@ -15,20 +15,23 @@ import os
 
 
 def load_policy_from_workdir(config, workdir):
-    """Rebuild the model and restore the newest checkpoint into an eval policy."""
+    """Rebuild the model and restore the newest checkpoint into an eval
+    policy — RT-1 (`RT1EvalPolicy`, rolling network state) or LAVA
+    (`LavaEvalPolicy`, history-window forward; reference Stack B
+    `eval/main.py:54-145`) per `config.model.family`."""
     import jax
     import numpy as np
 
-    from rt1_tpu.eval.policy import RT1EvalPolicy
+    from rt1_tpu.eval.policy import LavaEvalPolicy, RT1EvalPolicy
     from rt1_tpu.specs import language_table_action_space, sample_space
-    from rt1_tpu.train.train import build_model
+    from rt1_tpu.train.train import build_family
     from rt1_tpu.trainer import create_train_state, make_optimizer
     from rt1_tpu.trainer.checkpoints import (
         CheckpointConfig,
         CheckpointManager,
     )
 
-    model = build_model(config.model)
+    model, init_fn, _ = build_family(config.model)
     rng = jax.random.PRNGKey(0)
     t = config.model.time_sequence_length
     h, w = config.data.height, config.data.width
@@ -36,10 +39,20 @@ def load_policy_from_workdir(config, workdir):
         "image": np.zeros((1, t, h, w, 3), np.float32),
         "natural_language_embedding": np.zeros((1, t, 512), np.float32),
     }
+    family = config.model.get("family", "rt1")
+    lava_clip = (
+        family == "lava" and config.model.lava.lang_encoder == "clip"
+    )
+    if lava_clip:
+        obs["instruction_tokenized_clip"] = np.zeros(
+            (1, t, config.model.lava.get("text_context", 77)), np.int32
+        )
     actions = sample_space(
         language_table_action_space(), jax.random.fold_in(rng, 1), (1, t)
     )
-    state = create_train_state(model, rng, (obs, actions), make_optimizer())
+    state = create_train_state(
+        model, rng, (obs, actions), make_optimizer(), init_fn=init_fn
+    )
     ckpt = CheckpointManager(
         CheckpointConfig(
             directory=os.path.join(os.path.abspath(workdir), "checkpoints")
@@ -52,7 +65,27 @@ def load_policy_from_workdir(config, workdir):
     variables = {"params": state.params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
-    return RT1EvalPolicy(model, variables), step
+    # The history keys the policy's observation contract requires — kept
+    # here, next to the policy construction, so env setup can't drift.
+    history_keys = None  # evaluate.build_eval_env default
+    if lava_clip:
+        history_keys = (
+            "rgb_sequence", "natural_language_embedding", "instruction",
+            "effector_translation", "effector_target_translation",
+        )
+    if family == "lava":
+        clip_tokenizer = None
+        if lava_clip:
+            from rt1_tpu.train.train import _make_clip_tokenizer
+
+            clip_tokenizer = _make_clip_tokenizer(config)
+        policy = LavaEvalPolicy(
+            model, variables, sequence_length=t,
+            clip_tokenizer=clip_tokenizer,
+        )
+    else:
+        policy = RT1EvalPolicy(model, variables)
+    return policy, step, history_keys
 
 
 def main(argv):
@@ -77,7 +110,18 @@ def main(argv):
             "--allow_embedder_mismatch to override",
             manifest_name="data_manifest.json",
         )
-    policy, step = load_policy_from_workdir(config, FLAGS.workdir)
+    policy, step, history_keys = load_policy_from_workdir(
+        config, FLAGS.workdir
+    )
+    env_kwargs = dict(
+        target_height=config.data.height,
+        target_width=config.data.width,
+        random_crop_factor=config.data.crop_factor,
+        sequence_length=config.model.time_sequence_length,
+        backend=FLAGS.backend,
+    )
+    if history_keys is not None:
+        env_kwargs["history_keys"] = history_keys
     results = evaluate_policy(
         policy,
         workdir=FLAGS.workdir,
@@ -88,13 +132,7 @@ def main(argv):
         seed=FLAGS.seed,
         embedder=FLAGS.embedder,
         write_videos=FLAGS.videos,
-        env_kwargs=dict(
-            target_height=config.data.height,
-            target_width=config.data.width,
-            random_crop_factor=config.data.crop_factor,
-            sequence_length=config.model.time_sequence_length,
-            backend=FLAGS.backend,
-        ),
+        env_kwargs=env_kwargs,
     )
     results["checkpoint_step"] = step
     print(json.dumps(results))

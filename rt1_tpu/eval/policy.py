@@ -68,3 +68,78 @@ class RT1EvalPolicy:
         action = np.asarray(output["action"][0])
         action = action * max(self.action_std, EPS) + self.action_mean
         return np.clip(action, self.action_minimum, self.action_maximum)
+
+
+class LavaEvalPolicy:
+    """Closed-loop policy for the LAVA family (Stack B's `BCJaxPyPolicy`,
+    reference `train/policy.py:114-173` commented impl + `eval/main.py:54-145`).
+
+    Consumes the history-stacked observation (the last `sequence_length`
+    frames), runs one jitted `SequenceLAVMSE` forward, and clips the MSE
+    head's action. Stateless between steps — the temporal context lives in
+    the history wrapper, not a rolling network state (unlike RT-1's
+    `infer_step` cache).
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        sequence_length,
+        clip_tokenizer=None,
+        action_mean=0.0,
+        action_std=1.0,
+        action_minimum=-0.03,
+        action_maximum=0.03,
+    ):
+        import jax
+
+        self._model = model
+        self._sequence_length = sequence_length
+        self._clip_tokenizer = clip_tokenizer
+        self.action_mean = action_mean
+        self.action_std = action_std
+        self.action_minimum = action_minimum
+        self.action_maximum = action_maximum
+
+        @jax.jit
+        def _forward(observation):
+            return model.apply(variables, observation, train=False)
+
+        self._forward = _forward
+        self._token_cache_key = None
+        self._token_cache = None
+
+    def reset(self):
+        pass  # stateless: history comes from the wrapper
+
+    def _tokens_for(self, instruction_bytes):
+        """Tokenize once per episode: the instruction is reset-constant, and
+        BPE on the 10 Hz control path would be repeated host work."""
+        key = instruction_bytes.tobytes()
+        if key != self._token_cache_key:
+            from rt1_tpu.data.convert_rlds import decode_instruction_bytes
+
+            text = decode_instruction_bytes(instruction_bytes)
+            tokens = self._clip_tokenizer.tokenize_text(text)[0]
+            self._token_cache = np.tile(
+                tokens[None, None, :], (1, self._sequence_length, 1)
+            )
+            self._token_cache_key = key
+        return self._token_cache
+
+    def action(self, observation):
+        t = self._sequence_length
+        obs = {
+            "rgb": observation["rgb_sequence"][-t:][None].astype(np.float32),
+            "natural_language_embedding": observation[
+                "natural_language_embedding"
+            ][-t:][None].astype(np.float32),
+        }
+        if self._clip_tokenizer is not None:
+            obs["instruction_tokenized_clip"] = self._tokens_for(
+                observation["instruction"][-1]
+            )
+        action = np.asarray(self._forward(obs)[0])
+        action = action * max(self.action_std, EPS) + self.action_mean
+        return np.clip(action, self.action_minimum, self.action_maximum)

@@ -78,12 +78,13 @@ def fused_attention(
     if scale is None:
         scale = 1.0 / (d_in**0.5)
 
-    # Mosaic tiles fp32 as (8, 128): pad sequence to a multiple of 8 and
-    # head_dim to a multiple of 128 so the kernel lowers on real TPUs (RT-1's
-    # s=66, d=64 is unaligned). Padding changes no real output: padded K/V
-    # columns are masked out of every real row, padded Q rows attend only to
-    # themselves (keeps their softmax finite) and are sliced away.
-    s = -(-s_in // 8) * 8
+    # Mosaic tiles fp32 as (8, 128) and bf16 as (16, 128): pad sequence to a
+    # multiple of 16 (covers both) and head_dim to a multiple of 128 so the
+    # kernel lowers on real TPUs (RT-1's s=66, d=64 is unaligned). Padding
+    # changes no real output: padded K/V columns are masked out of every
+    # real row, padded Q rows attend only to themselves (keeps their softmax
+    # finite) and are sliced away.
+    s = -(-s_in // 16) * 16
     d = -(-d_in // 128) * 128
     pad_sd = [(0, 0), (0, s - s_in), (0, 0), (0, d - d_in)]
     if s != s_in or d != d_in:

@@ -48,6 +48,7 @@ flags.DEFINE_enum(
 flags.DEFINE_integer("height", 128, "Train/eval image height.")
 flags.DEFINE_integer("width", 224, "Train/eval image width.")
 flags.DEFINE_integer("batch", 32, "Per-host batch size.")
+flags.DEFINE_integer("checkpoint_every", 2500, "Checkpoint cadence (steps).")
 
 REWARD = "block2block"
 EVAL_SEED = 10_000  # disjoint from collection worker seeds (0..workers)
@@ -68,7 +69,7 @@ def get_train_config(data_dir, num_steps):
     # max(1, ...): steps_per_epoch=0 would collapse every milestone to
     # boundary 0 and train the whole run at the final decayed LR.
     config.steps_per_epoch = max(1, num_steps // 100)
-    config.checkpoint_every_steps = 2500
+    config.checkpoint_every_steps = FLAGS.checkpoint_every
     config.keep_period = 10000
     config.log_every_steps = 50
     config.eval_every_steps = 1000

@@ -58,7 +58,10 @@ def run_bench(mode, extra=(), timeout=1800):
     detail = None
     for line in proc.stderr.splitlines():
         if line.startswith('{"mode":'):
-            detail = json.loads(line)
+            try:
+                detail = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # interleaved/truncated child logging
     return headline, detail
 
 

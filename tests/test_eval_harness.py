@@ -135,3 +135,53 @@ def test_full_protocol_tiny(tiny_policy_setup):
     assert "block2block" in results["successes"]
     assert 0 <= results["successes"]["block2block"] <= 2
     assert results["episodes_per_reward"] == 2
+
+
+def test_lava_eval_policy_paths():
+    """LavaEvalPolicy: history slicing, clip tokenization from instruction
+    bytes, action clipping (the Stack-B BCJaxPyPolicy role,
+    reference eval/main.py:54-145)."""
+    import jax
+    import numpy as np
+
+    from rt1_tpu.eval.policy import LavaEvalPolicy
+    from rt1_tpu.models.lava import SequenceLAVMSE
+    from rt1_tpu.text.clip_bpe import default_tokenizer
+
+    tok = default_tokenizer()
+    t = 2
+    model = SequenceLAVMSE(
+        action_size=2,
+        dense_resnet_width=16,
+        dense_resnet_num_blocks=1,
+        lava_d_model=16,
+        lava_sequence_length=t,
+        lava_pyramid_fuse_layers=(2, 3, 4),
+        lava_image_encoder="conv_maxpool",
+        lava_lang_encoder="clip",
+        text_encoder_def=None,  # default tower; vocab >= tokenizer's 514
+    )
+    obs_init = {
+        "rgb": np.zeros((1, t, 64, 64, 3), np.float32),
+        "instruction_tokenized_clip": np.zeros((1, t, 77), np.int32),
+    }
+    variables = model.init({"params": jax.random.PRNGKey(0)}, obs_init,
+                           train=False)
+    policy = LavaEvalPolicy(
+        model, variables, sequence_length=t, clip_tokenizer=tok
+    )
+    policy.reset()
+
+    # History longer than the model window: only the last t frames are used.
+    k = 4
+    instruction = np.zeros((k, 512), np.int32)
+    raw = np.frombuffer(b"push the red moon", np.uint8).astype(np.int32)
+    instruction[:, : raw.shape[0]] = raw
+    observation = {
+        "rgb_sequence": np.random.default_rng(0).random((k, 64, 64, 3)),
+        "natural_language_embedding": np.zeros((k, 512), np.float32),
+        "instruction": instruction,
+    }
+    action = policy.action(observation)
+    assert action.shape == (2,)
+    assert np.all(action >= -0.03) and np.all(action <= 0.03)
