@@ -26,33 +26,50 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run_bench(mode, extra=(), timeout=1800):
+def run_bench(mode, extra=(), timeout=3600):
     """Run bench.py in a subprocess; return (headline dict, stderr detail).
 
     Never raises: parse failures / timeouts become {"error": ...} entries so
     one broken mode can't discard the minutes of TPU compile time the other
     modes already spent.
+
+    On timeout the child gets SIGINT first and 60 s to unwind: SIGKILLing an
+    axon client mid-claim leaves the chip grant held server-side, and every
+    later claim (the remaining modes, the driver's own bench run) then hangs
+    in the bind loop until the stale lease expires — observed to take >30 min.
     """
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", mode, *extra],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"),
-             "--mode", mode, *extra],
-            capture_output=True,
-            text=True,
-            cwd=REPO,
-            timeout=timeout,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
         return {"error": f"bench --mode {mode} timed out after {timeout}s"}, None
+    proc = subprocess.CompletedProcess(proc.args, proc.returncode, stdout, stderr)
     if proc.returncode != 0:
         return {"error": proc.stderr[-2000:]}, None
     headline = None
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
-            headline = json.loads(line)
-            break
+            parsed = json.loads(line)
         except (json.JSONDecodeError, ValueError):
             continue
+        if isinstance(parsed, dict):  # bare numbers/strings aren't headlines
+            headline = parsed
+            break
     if headline is None:
         return {"error": f"no JSON on stdout: {proc.stdout[-500:]!r}"}, None
     detail = None
@@ -91,18 +108,46 @@ def ring_forward_on_chip():
     return {"max_abs_err_vs_dense": err, "ok": err < 1e-4}
 
 
+def wait_for_chip(max_probes=20, probe_timeout=120, sleep_s=180):
+    """Block until the axon chip is claimable (probe in a subprocess).
+
+    A SIGKILLed client leaves the grant held server-side; probing with a
+    subprocess (which exits cleanly, releasing its own claim) tells us when
+    the stale lease has expired without wedging this process.
+    """
+    import time as _time
+
+    for i in range(max_probes):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=probe_timeout + 60,
+                capture_output=True,
+                cwd=REPO,
+            )
+            if probe.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass  # hung in the bind loop == lease still held
+        print(f"chip probe {i + 1}: not claimable yet", flush=True)
+        _time.sleep(sleep_s)
+    return False
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="TPU_VALIDATION.json")
     parser.add_argument("--skip_bench", action="store_true")
     args = parser.parse_args()
 
-    import jax
-
+    # Configure the persistent compilation cache (jax.config only — does NOT
+    # initialize the backend). The parent must not *initialize* jax (e.g.
+    # jax.devices()) before the bench subprocesses: backend init claims the
+    # chip for this process's whole lifetime and contends with every child.
     from rt1_tpu.compilation_cache import enable_persistent_cache
 
     enable_persistent_cache()
-    results = {"devices": [str(d) for d in jax.devices()]}
+    results = {}
     out_path = os.path.join(REPO, args.out)
 
     def checkpoint_results():
@@ -117,12 +162,28 @@ def main():
                 results[f"bench_{mode}_detail"] = detail
             print(mode, "->", headline, flush=True)
             checkpoint_results()
+            if "error" in (headline or {}):
+                wait_for_chip()
 
         for impl in ("dense", "pallas"):
             headline, _ = run_bench("infer", ["--attention_impl", impl])
             results[f"bench_infer_{impl}"] = headline
             print("infer", impl, "->", headline, flush=True)
             checkpoint_results()
+            if "error" in (headline or {}):
+                wait_for_chip()
+
+    # Device inventory via a short-lived subprocess, independent of the ring
+    # test's outcome (and releasing its claim immediately).
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, json; print(json.dumps([str(d) for d in jax.devices()]))"],
+            timeout=180, capture_output=True, text=True, cwd=REPO,
+        )
+        results["devices"] = json.loads(probe.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        results["devices"] = f"probe failed: {e!r}"[:200]
 
     try:
         results["ring_on_chip"] = ring_forward_on_chip()
