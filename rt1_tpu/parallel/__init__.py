@@ -9,6 +9,12 @@ GSPMD insert XLA collectives (psum / all-gather / reduce-scatter) over ICI.
 """
 
 from rt1_tpu.parallel.mesh import MeshConfig, make_mesh
+from rt1_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pp_causal_transformer_apply,
+    stack_layer_params,
+    unstack_layer_params,
+)
 from rt1_tpu.parallel.sharding import (
     batch_sharding,
     replicated,
@@ -21,8 +27,12 @@ __all__ = [
     "MeshConfig",
     "make_mesh",
     "batch_sharding",
+    "pipeline_apply",
+    "pp_causal_transformer_apply",
     "replicated",
     "rt1_parameter_rules",
     "shard_pytree",
     "sharding_for_path",
+    "stack_layer_params",
+    "unstack_layer_params",
 ]

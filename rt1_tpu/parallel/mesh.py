@@ -12,6 +12,8 @@ Axis conventions used throughout rt1_tpu:
 * ``seq``   — sequence/context parallelism (ring attention); unused for the 66-token
   RT-1 window (SURVEY.md §5 "long-context: absent") but first-class in the API so
   long-horizon variants can turn it on.
+* ``stage`` — pipeline parallelism (GPipe-style microbatch rotation over layer
+  stages, rt1_tpu/parallel/pipeline.py). Like ``seq``, beyond reference parity.
 
 All axes are optional; size-1 axes are free (no collectives are emitted for them).
 """
@@ -33,33 +35,41 @@ class MeshConfig:
     data: int = -1
     model: int = 1
     seq: int = 1
+    stage: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.model * self.seq
+        fixed = self.model * self.seq * self.stage
         if n_devices % fixed != 0:
             raise ValueError(
-                f"{n_devices} devices not divisible by model*seq={fixed}"
+                f"{n_devices} devices not divisible by "
+                f"model*seq*stage={fixed}"
             )
         data = self.data if self.data != -1 else n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.model}x{self.seq} != {n_devices} devices"
+                f"mesh {data}x{self.stage}x{self.seq}x{self.model} != "
+                f"{n_devices} devices"
             )
-        return MeshConfig(data=data, model=self.model, seq=self.seq)
+        return MeshConfig(
+            data=data, model=self.model, seq=self.seq, stage=self.stage
+        )
 
 
 def make_mesh(
     config: MeshConfig = MeshConfig(),
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a ('data', 'seq', 'model') mesh over `devices` (default: all).
+    """Build a ('data', 'stage', 'seq', 'model') mesh over `devices` (default: all).
 
     Axis order puts ``model`` innermost so tensor-parallel collectives ride the
     fastest ICI links (nearest-neighbor on a TPU slice), ``data`` outermost so DP
     psum tolerates the slower hops (and DCN across hosts on multi-host slices,
-    where `jax.devices()` is already ordered host-major).
+    where `jax.devices()` is already ordered host-major). ``stage`` sits next to
+    ``data``: pipeline ppermutes are point-to-point once per microbatch tick —
+    far less bandwidth-hungry than TP/SP collectives — so they get the longer
+    hops.
     """
     devices = list(devices if devices is not None else jax.devices())
     cfg = config.resolve(len(devices))
-    arr = np.asarray(devices).reshape(cfg.data, cfg.seq, cfg.model)
-    return Mesh(arr, axis_names=("data", "seq", "model"))
+    arr = np.asarray(devices).reshape(cfg.data, cfg.stage, cfg.seq, cfg.model)
+    return Mesh(arr, axis_names=("data", "stage", "seq", "model"))

@@ -1,0 +1,201 @@
+"""Pipeline parallelism: GPipe-style microbatch rotation over a ``stage`` axis.
+
+Beyond reference parity (SURVEY.md §2.6: "Pipeline parallelism: No") — the
+reference never shards layers. Here the decoder's layer stack can be
+partitioned over the mesh's ``stage`` axis, with microbatches flowing
+stage-to-stage over ICI via `jax.lax.ppermute` inside a `shard_map`:
+
+  tick t:  stage 0 ingests microbatch t;  stage s computes the microbatch it
+           received from stage s-1 last tick;  after M + S - 1 ticks every
+           microbatch has crossed all S stages.
+
+This is the collective-pipelining recipe (one `lax.scan` over ticks, a rotate
+per tick) rather than a hand-scheduled 1F1B: autodiff through the scan +
+ppermute gives the backward pipeline for free, and XLA overlaps the
+(tiny, point-to-point) rotate with each stage's compute. Bubble fraction is
+the GPipe (S-1)/(M+S-1); pick ``num_microbatches`` ≥ 4·S to amortize.
+
+The unit here is a *stage function* ``stage_fn(stage_params, x) -> y`` with
+``y.shape == x.shape`` (true for transformer blocks: (b, s, d_model) in/out).
+``stacked_params`` holds every stage's parameters stacked on a leading axis
+of size S·(layers-per-stage); `shard_map` splits that axis across stages, and
+each stage folds its own chunk with an inner `lax.scan` (layers are
+sequential within a stage).
+
+`pp_causal_transformer_apply` applies a full `CausalTransformer`
+(models/transformer.py) this way from its standard Flax params — embedding
+and head are computed replicated (they are <2% of FLOPs); only the layer
+stack is pipelined. Exactness vs the sequential module is pinned by
+tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_layer_params(params: Any, num_layers: int, prefix: str = "layer_") -> Any:
+    """Stack `CausalTransformer` per-layer param subtrees on a leading axis.
+
+    Takes the module's standard params dict ({'layer_0': {...}, ...}) and
+    returns a single pytree whose leaves have a leading ``num_layers`` axis —
+    the layout `pipeline_apply` shards over ``stage``.
+    """
+    layers = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layer_params(stacked: Any, prefix: str = "layer_") -> dict:
+    """Inverse of `stack_layer_params` (for porting params back)."""
+    num_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return {
+        f"{prefix}{i}": jax.tree.map(lambda x, i=i: x[i], stacked)
+        for i in range(num_layers)
+    }
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    stage_axis: str = "stage",
+    data_axis: Optional[str] = "data",
+) -> jnp.ndarray:
+    """Run ``x`` through S pipelined stages; returns the final activations.
+
+    * ``stacked_params`` leaves: (L, ...) with L divisible by S; stage s owns
+      the [s·L/S, (s+1)·L/S) slice and scans `stage_fn` over it.
+    * ``x``: (b, ...) activations. With a >1 ``data`` axis the batch dim is
+      sharded over it (each data row runs an independent pipeline down its
+      own stage column). The per-shard batch must divide `num_microbatches`.
+    * Output == sequentially applying all L layers (exact; no renorm).
+
+    Differentiable: the backward pass pipelines in reverse through the same
+    scan/ppermute structure via autodiff.
+    """
+    S = mesh.shape[stage_axis]
+    if S == 1:  # degenerate: plain scan over the stack, no collectives
+        def fold(x, p):
+            return stage_fn(p, x), None
+
+        out, _ = jax.lax.scan(fold, x, stacked_params)
+        return out
+
+    L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if L % S != 0:
+        raise ValueError(f"{L} stacked layers not divisible by {S} stages")
+    M = num_microbatches
+    batch_spec = (
+        P(data_axis)
+        if data_axis and mesh.shape.get(data_axis, 1) > 1
+        else P()
+    )
+
+    def local(params_chunk, x_local):
+        # params_chunk leaves: (L/S, ...) — this stage's layers.
+        b_local = x_local.shape[0]
+        if b_local % M != 0:
+            raise ValueError(
+                f"per-shard batch {b_local} not divisible by "
+                f"num_microbatches={M}"
+            )
+        mb = b_local // M
+        s_idx = jax.lax.axis_index(stage_axis)
+        feed = x_local.reshape((M, mb) + x_local.shape[1:])
+        # Ticks M..M+S-2 feed no new microbatch; zeros keep shapes static.
+        pad = jnp.zeros((S - 1,) + feed.shape[1:], feed.dtype)
+        feed = jnp.concatenate([feed, pad], axis=0)  # (T, mb, ...)
+
+        def run_stage(x_in):
+            def fold(x, p):
+                return stage_fn(p, x), None
+
+            out, _ = jax.lax.scan(fold, x_in, params_chunk)
+            return out
+
+        rotate = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(prev_y, x_t):
+            incoming = jax.lax.ppermute(prev_y, stage_axis, rotate)
+            x_in = jnp.where(s_idx == 0, x_t, incoming)
+            y = run_stage(x_in)
+            return y, y
+
+        y0 = jnp.zeros(feed.shape[1:], feed.dtype)
+        _, ys = jax.lax.scan(tick, y0, feed)  # (T, mb, ...)
+        # Microbatch m exits the last stage at tick S-1+m. Replicate the
+        # last stage's results to every stage with a masked psum so the
+        # caller sees identical activations on all shards.
+        out = ys[S - 1:]                      # (M, mb, ...)
+        out = out * (s_idx == S - 1).astype(out.dtype)
+        out = jax.lax.psum(out, stage_axis)
+        return out.reshape((b_local,) + x_local.shape[1:])
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(stage_axis), batch_spec),
+        out_specs=batch_spec,
+        check_rep=False,
+    )(stacked_params, x)
+
+
+def pp_causal_transformer_apply(
+    transformer: Any,
+    params: Any,
+    inputs: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    attention_mask: Optional[jnp.ndarray] = None,
+    stage_axis: str = "stage",
+) -> jnp.ndarray:
+    """`CausalTransformer.__call__` with the layer stack pipelined.
+
+    ``transformer`` is the `CausalTransformer` module instance (for its
+    hyperparameters), ``params`` its standard Flax params. Embedding, the
+    positional table, and the vocab head run replicated; the N pre-norm
+    blocks run under `pipeline_apply`. Deterministic (train=False) — dropout
+    inside a pipelined stage would need per-stage rng plumbing; training
+    with PP uses the same structure with `rngs` folded into the stage id,
+    which is left to the trainer integration.
+    """
+    from rt1_tpu.models.transformer import TransformerLayer
+
+    b, s, _ = inputs.shape
+    p = params["params"] if "params" in params else params
+    x = inputs @ p["token_emb"]["kernel"] + p["token_emb"]["bias"]
+    x = x + p["position_emb"]["embedding"][None, :s, :]
+
+    layer = TransformerLayer(
+        key_dim=transformer.key_dim,
+        num_heads=transformer.num_heads,
+        d_model=transformer.d_model,
+        dropout_rate=transformer.dropout_rate,
+        dtype=transformer.dtype,
+    )
+
+    def stage_fn(layer_params, h):
+        out, _ = layer.apply(
+            {"params": layer_params}, h, mask=attention_mask, train=False
+        )
+        return out
+
+    stacked = stack_layer_params(p, transformer.num_layers)
+    x = pipeline_apply(
+        stage_fn,
+        stacked,
+        x,
+        mesh=mesh,
+        num_microbatches=num_microbatches,
+        stage_axis=stage_axis,
+    )
+    return x @ p["output_tokens"]["kernel"] + p["output_tokens"]["bias"]

@@ -38,9 +38,9 @@ def test_multistep_lr_schedule():
 
 def test_mesh_shapes():
     mesh = make_mesh(MeshConfig())
-    assert mesh.shape == {"data": 8, "seq": 1, "model": 1}
+    assert mesh.shape == {"data": 8, "stage": 1, "seq": 1, "model": 1}
     mesh = make_mesh(MeshConfig(model=4))
-    assert mesh.shape == {"data": 2, "seq": 1, "model": 4}
+    assert mesh.shape == {"data": 2, "stage": 1, "seq": 1, "model": 4}
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(data=3, model=3))
 
