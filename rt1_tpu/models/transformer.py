@@ -130,7 +130,13 @@ class TFMultiHeadAttention(nn.Module):
 
 
 class TransformerLayer(nn.Module):
-    """Pre-norm block: x + MHA(LN(x)); x + Dropout(Dense(LN(x))) (reference :130-144)."""
+    """Pre-norm block: x + MHA(LN(x)); x + Dropout(FFN(LN(x))) (reference :130-144).
+
+    ``ffn_impl="dense"`` is the reference-parity single square Dense;
+    ``ffn_impl="moe"`` swaps in the Switch-routed expert FFN
+    (rt1_tpu/models/moe.py) — its load-balancing aux loss is sown into the
+    "intermediates" collection under "moe_aux_loss".
+    """
 
     key_dim: int
     num_heads: int
@@ -140,6 +146,9 @@ class TransformerLayer(nn.Module):
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
     pallas_interpret: bool = False
+    ffn_impl: str = "dense"          # "dense" | "moe"
+    num_experts: int = 4
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -157,7 +166,19 @@ class TransformerLayer(nn.Module):
         )(y, mask=mask, train=train)
         x = x + attn_out
         y = nn.LayerNorm(dtype=self.dtype, name="norm_2")(x)
-        y = nn.Dense(self.d_model, dtype=self.dtype, name="ff")(y)
+        if self.ffn_impl == "moe":
+            from rt1_tpu.models.moe import MoEFeedForward
+
+            y, aux = MoEFeedForward(
+                d_model=self.d_model,
+                num_experts=self.num_experts,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
+            self.sow("intermediates", "moe_aux_loss", aux)
+        else:
+            y = nn.Dense(self.d_model, dtype=self.dtype, name="ff")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return x + y, scores
 
@@ -177,6 +198,9 @@ class CausalTransformer(nn.Module):
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
     pallas_interpret: bool = False
+    ffn_impl: str = "dense"          # "dense" | "moe" (expert-parallel FFN)
+    num_experts: int = 4
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
@@ -211,6 +235,9 @@ class CausalTransformer(nn.Module):
                 attention_impl=self.attention_impl,
                 mesh=self.mesh,
                 pallas_interpret=self.pallas_interpret,
+                ffn_impl=self.ffn_impl,
+                num_experts=self.num_experts,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"layer_{i}",
             )(x, mask=attention_mask, train=train)
             if self.return_attention_scores:

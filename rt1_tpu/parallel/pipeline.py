@@ -175,12 +175,22 @@ def pp_causal_transformer_apply(
     x = inputs @ p["token_emb"]["kernel"] + p["token_emb"]["bias"]
     x = x + p["position_emb"]["embedding"][None, :s, :]
 
+    if transformer.attention_impl != "dense":
+        # Ring/pallas attention inside a pipelined stage would nest their
+        # own collectives/kernels under this shard_map; unsupported.
+        raise ValueError(
+            "pipeline parallelism supports attention_impl='dense' only, "
+            f"got {transformer.attention_impl!r}"
+        )
     layer = TransformerLayer(
         key_dim=transformer.key_dim,
         num_heads=transformer.num_heads,
         d_model=transformer.d_model,
         dropout_rate=transformer.dropout_rate,
         dtype=transformer.dtype,
+        ffn_impl=transformer.ffn_impl,
+        num_experts=transformer.num_experts,
+        moe_capacity_factor=transformer.moe_capacity_factor,
     )
 
     def stage_fn(layer_params, h):

@@ -54,6 +54,17 @@ def rt1_parameter_rules() -> List[Rule]:
         # Vocab head: (d_model, vocab) — column-shard.
         (r"transformer/output_tokens/kernel$", P(None, "model")),
         (r"transformer/output_tokens/bias$", P("model")),
+    ] + moe_parameter_rules()
+
+
+def moe_parameter_rules() -> List[Rule]:
+    """Expert parallelism: stacked expert weights (E, d, ff) sharded over
+    ``model`` on the expert axis. GSPMD lowers the dispatch/combine einsums
+    (models/moe.py) to all-to-alls over ICI; the fp32 router stays
+    replicated so every shard routes identically.
+    """
+    return [
+        (r"moe/(wi|wo)$", P("model", None, None)),
     ]
 
 

@@ -133,6 +133,47 @@ def test_stack_unstack_roundtrip():
     )
 
 
+def test_pp_causal_transformer_moe_matches_module():
+    """PP composes with the MoE FFN (stage layers carry the full config)."""
+    mesh = make_mesh(
+        MeshConfig(data=1, stage=2), devices=jax.devices()[:2]
+    )
+    t = CausalTransformer(
+        num_layers=2, key_dim=8, num_heads=2, d_model=16, vocab_size=32,
+        dropout_rate=0.0, ffn_impl="moe", num_experts=2,
+    )
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 6, 16))
+    variables = t.init(rng, x)
+    want = t.apply(variables, x, train=False)
+    got = jax.jit(
+        lambda v, x: pp_causal_transformer_apply(
+            t, v, x, mesh=mesh, num_microbatches=2
+        )
+    )(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_pp_rejects_nondense_attention():
+    mesh = make_mesh(
+        MeshConfig(data=1, stage=2), devices=jax.devices()[:2]
+    )
+    t = CausalTransformer(
+        num_layers=2, key_dim=8, num_heads=2, d_model=16, vocab_size=32,
+        attention_impl="ring",
+    )
+    x = jnp.ones((2, 4, 16))
+    variables = CausalTransformer(
+        num_layers=2, key_dim=8, num_heads=2, d_model=16, vocab_size=32
+    ).init(jax.random.PRNGKey(0), x)
+    with pytest.raises(ValueError, match="dense"):
+        pp_causal_transformer_apply(
+            t, variables, x, mesh=mesh, num_microbatches=2
+        )
+
+
 def test_pp_causal_transformer_matches_module():
     """Full decoder: pipelined apply ≡ the sequential Flax module."""
     mesh = make_mesh(
