@@ -68,16 +68,18 @@ class MoEFeedForward(nn.Module):
         density_proxy = gates.mean(axis=0)
         aux_loss = (density * density_proxy).sum() * e
 
-        # Position of each token within its expert's queue; drop past capacity.
+        # Position of each token within its expert's queue. NOTE: `t` is the
+        # *call's* token count — under data parallelism this is the global
+        # batch, so per-device expert buffers (E, C, d) grow with DP width
+        # (they are sharded over 'model', not 'data'). For very large global
+        # batches, lower capacity_factor or wrap the MoE in a shard_map over
+        # 'data' so capacity binds per data shard.
         capacity = int(self.capacity_factor * t / e) or 1
         position_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot
-        within_capacity = (position_in_expert < capacity).astype(jnp.float32)
-        pos_one_hot = jax.nn.one_hot(          # (t, c); all-zero past capacity
+        pos_one_hot = jax.nn.one_hot(   # (t, c); out-of-range (≥ capacity)
             position_in_expert.sum(axis=-1), capacity, dtype=jnp.float32
-        )
-        dispatch = (
-            (one_hot * within_capacity)[:, :, None] * pos_one_hot[:, None, :]
-        )                                                      # (t, e, c)
+        )                               # rows are all-zero → token dropped
+        dispatch = one_hot[:, :, None] * pos_one_hot[:, None, :]  # (t, e, c)
 
         wi = self.param(
             "wi", nn.initializers.lecun_normal(), (e, d, ff), jnp.float32

@@ -108,6 +108,16 @@ class RT1Policy(nn.Module):
     attention_impl: str = "dense"
     mesh: Optional[Any] = None
     pallas_interpret: bool = False  # test-only: run the kernel off-TPU
+    # FFN choice for the decoder blocks: "dense" (reference parity) or "moe"
+    # (Switch-routed expert FFN, rt1_tpu/models/moe.py — expert-parallel when
+    # the stacked expert weights are sharded over 'model'). The Switch
+    # load-balancing aux loss is sown into intermediates and added to the
+    # training loss by the trainer with weight `moe_aux_weight`.
+    ffn_impl: str = "dense"
+    num_experts: int = 4
+    moe_capacity_factor: float = 2.0
+    moe_ff_dim: Optional[int] = None
+    moe_aux_weight: float = 0.01
     # Optional custom image tokenizer module (must map (b,t,H,W,3), (b,t,D) →
     # (b,t,num_image_tokens,token_embedding_size)); used by tests to swap the
     # EfficientNet-B3 backbone for a tiny one.
@@ -156,6 +166,10 @@ class RT1Policy(nn.Module):
             attention_impl=self.attention_impl,
             mesh=self.mesh,
             pallas_interpret=self.pallas_interpret,
+            ffn_impl=self.ffn_impl,
+            num_experts=self.num_experts,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_ff_dim=self.moe_ff_dim,
         )
         self._mask = rt1_attention_mask(
             self.time_sequence_length, self.tokens_per_image, self.tokens_per_action

@@ -149,6 +149,7 @@ class TransformerLayer(nn.Module):
     ffn_impl: str = "dense"          # "dense" | "moe"
     num_experts: int = 4
     moe_capacity_factor: float = 2.0
+    moe_ff_dim: Optional[int] = None  # expert hidden width; None → d_model
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -172,6 +173,7 @@ class TransformerLayer(nn.Module):
             y, aux = MoEFeedForward(
                 d_model=self.d_model,
                 num_experts=self.num_experts,
+                ff_dim=self.moe_ff_dim,
                 capacity_factor=self.moe_capacity_factor,
                 dtype=self.dtype,
                 name="moe",
@@ -201,6 +203,7 @@ class CausalTransformer(nn.Module):
     ffn_impl: str = "dense"          # "dense" | "moe" (expert-parallel FFN)
     num_experts: int = 4
     moe_capacity_factor: float = 2.0
+    moe_ff_dim: Optional[int] = None
 
     @nn.compact
     def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
@@ -238,6 +241,7 @@ class CausalTransformer(nn.Module):
                 ffn_impl=self.ffn_impl,
                 num_experts=self.num_experts,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_ff_dim=self.moe_ff_dim,
                 name=f"layer_{i}",
             )(x, mask=attention_mask, train=train)
             if self.return_attention_scores:

@@ -167,6 +167,14 @@ def pp_causal_transformer_apply(
     inside a pipelined stage would need per-stage rng plumbing; training
     with PP uses the same structure with `rngs` folded into the stage id,
     which is left to the trainer integration.
+
+    MoE caveat (``ffn_impl="moe"``): expert capacity is computed over the
+    tokens of each *forward call*, so under PP it binds per microbatch
+    (b/M·s tokens) rather than per batch — the standard per-device-batch
+    semantics of MoE systems. Outputs match the sequential module exactly
+    whenever no expert overflows its capacity (e.g. capacity_factor ≥
+    num_experts guarantees it for top-1 routing); when drops do occur, the
+    two schedules may drop different tokens.
     """
     from rt1_tpu.models.transformer import TransformerLayer
 
@@ -191,6 +199,7 @@ def pp_causal_transformer_apply(
         ffn_impl=transformer.ffn_impl,
         num_experts=transformer.num_experts,
         moe_capacity_factor=transformer.moe_capacity_factor,
+        moe_ff_dim=transformer.moe_ff_dim,
     )
 
     def stage_fn(layer_params, h):

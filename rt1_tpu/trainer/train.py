@@ -58,13 +58,48 @@ def _loss_fn(model, params, batch_stats, batch: Batch, rng: jax.Array, train: bo
         "dropout": jax.random.fold_in(rng, 1),
         "augment": jax.random.fold_in(rng, 2),
     }
+    # MoE decoders sow a Switch load-balancing aux loss into intermediates
+    # (models/moe.py); without it top-1 routing collapses onto one expert.
+    # Train-only: eval loss stays the pure task loss so checkpoint selection
+    # and dense-baseline comparisons are unaffected by the regularizer.
+    use_moe = train and getattr(model, "ffn_impl", "dense") == "moe"
+    mutable = []
     if train and batch_stats:
+        mutable.append("batch_stats")
+    if use_moe:
+        mutable.append("intermediates")
+
+    if mutable:
         out, mutated = model.apply(
-            variables, obs, actions, train=True, rngs=rngs, mutable=["batch_stats"]
+            variables,
+            obs,
+            actions,
+            train=train,
+            rngs=rngs if train else None,
+            mutable=mutable,
         )
-        return out["loss"], (out, mutated["batch_stats"])
-    out = model.apply(variables, obs, actions, train=train, rngs=rngs if train else None)
-    return out["loss"], (out, batch_stats)
+        new_bs = mutated.get("batch_stats", batch_stats)
+    else:
+        out = model.apply(
+            variables, obs, actions, train=train, rngs=rngs if train else None
+        )
+        mutated = {}
+        new_bs = batch_stats
+
+    loss = out["loss"]
+    if use_moe and "intermediates" in mutated:
+        aux_leaves = [
+            jnp.asarray(v, jnp.float32)
+            for path, v in jax.tree_util.tree_flatten_with_path(
+                mutated["intermediates"]
+            )[0]
+            if "moe_aux_loss" in jax.tree_util.keystr(path)
+        ]
+        if aux_leaves:
+            aux = sum(jnp.mean(a) for a in aux_leaves) / len(aux_leaves)
+            loss = loss + getattr(model, "moe_aux_weight", 0.01) * aux
+            out = dict(out, loss=loss, moe_aux_loss=aux)
+    return loss, (out, new_bs)
 
 
 def make_train_step_fns(
@@ -143,6 +178,8 @@ def make_train_step_fns(
         }
         if "action_loss" in out:
             metrics["action_loss_mean"] = jnp.mean(out["action_loss"])
+        if "moe_aux_loss" in out:  # routing-collapse monitor
+            metrics["moe_aux_loss"] = out["moe_aux_loss"]
         return new_state, metrics
 
     def eval_step(state: TrainState, batch: Batch):

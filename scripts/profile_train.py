@@ -1,0 +1,97 @@
+"""Capture an XPlane/TensorBoard profiler trace of the flagship train step.
+
+The reference has no profiling story beyond Lightning's progress bar
+(SURVEY.md §5 "Tracing/profiling"); Stack B wraps steps in
+`jax.profiler.StepTraceAnnotation` (`language_table/train/train.py:182`).
+This script is the deep-dive companion: it traces N real train steps on the
+attached chip with `jax.profiler.start_trace` (XPlane protos viewable in
+TensorBoard's profile plugin or Perfetto) and prints per-step wall times.
+
+Run (claims the TPU):
+  python scripts/profile_train.py --logdir /tmp/rt1_trace --steps 5
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--logdir", default="/tmp/rt1_trace")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--height", type=int, default=256)
+    p.add_argument("--width", type=int, default=456)
+    args = p.parse_args()
+
+    import jax
+
+    from rt1_tpu.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax.numpy as jnp
+
+    from rt1_tpu.models.rt1 import RT1Policy
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from rt1_tpu.trainer import (
+        create_train_state,
+        make_optimizer,
+        make_train_step_fns,
+    )
+    from rt1_tpu.trainer.metrics import step_trace
+
+    model = RT1Policy(
+        action_space=language_table_action_space(),
+        time_sequence_length=6,
+        dtype=jnp.bfloat16,
+    )
+    rng = jax.random.PRNGKey(0)
+    b, t = args.batch, 6
+    obs = {
+        "image": jax.random.uniform(rng, (b, t, args.height, args.width, 3)),
+        "natural_language_embedding": jax.random.normal(
+            jax.random.fold_in(rng, 1), (b, t, 512)
+        ),
+    }
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 2), (b, t)
+    )
+    mesh = make_mesh(MeshConfig())
+    state = create_train_state(model, rng, (obs, actions), make_optimizer())
+    fns = make_train_step_fns(model, mesh, state)
+    state = fns.shard_state(state)
+    batch = fns.shard_batch((obs, actions))
+
+    for i in range(args.warmup):
+        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+        jax.block_until_ready(metrics["loss"])
+
+    jax.profiler.start_trace(args.logdir)
+    times = []
+    for i in range(args.steps):
+        with step_trace("train", i):
+            t0 = time.perf_counter()
+            state, metrics = fns.train_step(
+                state, batch, jax.random.fold_in(rng, 100 + i)
+            )
+            jax.block_until_ready(metrics["loss"])
+            times.append(time.perf_counter() - t0)
+    jax.profiler.stop_trace()
+
+    for i, dt in enumerate(times):
+        print(f"step {i}: {dt * 1e3:.2f} ms")
+    print(
+        f"trace written to {args.logdir} — view with TensorBoard's profile "
+        "plugin (xplane.pb) or convert to Perfetto."
+    )
+
+
+if __name__ == "__main__":
+    main()
