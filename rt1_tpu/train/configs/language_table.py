@@ -28,6 +28,13 @@ def get_config():
     config.model.image_tokenizer = "efficientnet_b3"
     config.model.dtype = "bfloat16"
     config.model.photometric_augmentation = False
+    # Decoder FFN: "dense" (reference parity) or "moe" (Switch expert FFN,
+    # expert-parallel over the mesh's 'model' axis — models/moe.py).
+    config.model.ffn_impl = "dense"
+    config.model.num_experts = 4
+    config.model.moe_aux_weight = 0.01
+    config.model.moe_capacity_factor = 2.0
+    config.model.moe_ff_dim = ml_collections.config_dict.placeholder(int)
 
     # LAVA family fields (used when family == "lava"; defaults mirror the
     # reference's SequenceLAVMSE config, `train/configs/

@@ -80,6 +80,13 @@ def build_model(model_config):
         photometric_augmentation=model_config.get(
             "photometric_augmentation", False
         ),
+        # Opt-in Switch MoE decoder FFN (models/moe.py); "dense" is
+        # reference parity.
+        ffn_impl=model_config.get("ffn_impl", "dense"),
+        num_experts=model_config.get("num_experts", 4),
+        moe_aux_weight=model_config.get("moe_aux_weight", 0.01),
+        moe_capacity_factor=model_config.get("moe_capacity_factor", 2.0),
+        moe_ff_dim=model_config.get("moe_ff_dim", None),
         dtype=jnp.bfloat16
         if model_config.dtype == "bfloat16"
         else jnp.float32,

@@ -149,12 +149,15 @@ def make_train_step_fns(
             extra = float(accum_steps) if ref_scale else 1.0
 
             def micro(carry, xs):
-                grads_acc, loss_acc, bs = carry
+                grads_acc, loss_acc, aux_acc, bs = carry
                 mb, r = xs
-                (l, (_, bs)), g = grad_fn(state.params, bs, mb, r)
+                (l, (mb_out, bs)), g = grad_fn(state.params, bs, mb, r)
+                # Metric only: the aux term's gradient already flows via l.
+                aux_acc = aux_acc + mb_out.get("moe_aux_loss", jnp.zeros(()))
                 return (
                     jax.tree.map(jnp.add, grads_acc, g),
                     loss_acc + l,
+                    aux_acc,
                     bs,
                 ), None
 
@@ -164,12 +167,16 @@ def make_train_step_fns(
             micro_batches = jax.tree.map(split, batch)
             rngs = jax.random.split(rng, accum_steps)
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            (grads, loss, new_bs), _ = jax.lax.scan(
-                micro, (zero_grads, jnp.zeros(()), state.batch_stats), (micro_batches, rngs)
+            (grads, loss, aux, new_bs), _ = jax.lax.scan(
+                micro,
+                (zero_grads, jnp.zeros(()), jnp.zeros(()), state.batch_stats),
+                (micro_batches, rngs),
             )
             grads = jax.tree.map(lambda g: g / (accum_steps * extra), grads)
             loss = loss / (accum_steps * extra)
             out = {"loss": loss}
+            if getattr(model, "ffn_impl", "dense") == "moe":
+                out["moe_aux_loss"] = aux / accum_steps  # mean over micros
 
         new_state = state.apply_gradients(grads, new_batch_stats=new_bs)
         metrics = {
