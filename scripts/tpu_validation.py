@@ -47,6 +47,10 @@ def run_bench(mode, extra=(), timeout=3600):
         stderr=subprocess.PIPE,
         text=True,
         cwd=REPO,
+        # This driver probes claimability itself (wait_for_chip); skip
+        # bench.py's own probe so each mode pays backend init only twice
+        # (probe here + bench proper), not three times.
+        env={**os.environ, "RT1_BENCH_SKIP_PROBE": "1"},
     )
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
