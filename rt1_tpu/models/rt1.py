@@ -98,6 +98,13 @@ class RT1Policy(nn.Module):
     crop_ratio: float = 0.07          # pad-and-random-shift ratio (preprocessors.py:37)
     photometric_augmentation: bool = False  # on-device color jitter (train only)
     loss_scale: str = "reference"     # 'reference' (:314-319) or 'mean'
+    # Focal modulation of the action-token CE (Lin et al. 2017): ce *=
+    # (1 - p_label)^gamma. 0 disables (reference parity). BC on smooth
+    # scripted demos concentrates labels on a few near-center buckets, so a
+    # near-constant policy already scores low CE (the "copycat" collapse
+    # diagnosed in RESULTS.md round 2); gamma > 0 down-weights those easy
+    # marginal tokens and shifts gradient onto the rare directional ones.
+    focal_gamma: float = 0.0
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
     # "dense" (default), "ring", or "pallas". "ring" shards the token
@@ -267,11 +274,22 @@ class RT1Policy(nn.Module):
         action_logits = action_logits.reshape(b, t, self.tokens_per_action, self.vocab_size)
 
         ce = _softmax_ce_int(action_logits.astype(jnp.float32), labels)  # (b, t, A)
+        loss_terms = ce
+        if self.focal_gamma > 0:
+            # ce = -log p_label, so 1 - p_label = -expm1(-ce); gradients flow
+            # through the modulating factor too (the standard focal-loss
+            # form). The floor keeps the power branch differentiable at
+            # ce == 0 for fractional gamma (x**g has an infinite slope at 0
+            # when g < 1, and saturated easy tokens do reach ce == 0 in fp32).
+            # Only the optimized loss is modulated; the "cross_entropy" aux
+            # output stays raw CE so it remains comparable across gammas.
+            base = jnp.maximum(-jnp.expm1(-ce), 1e-12)
+            loss_terms = base ** self.focal_gamma * ce
         if self.loss_scale == "reference":
             num_items = float(b * t) * self.single_step_tokens
-            action_loss = jnp.mean(ce, axis=-1) / num_items  # (b, t), reference :314-320
+            action_loss = jnp.mean(loss_terms, axis=-1) / num_items  # (b, t), reference :314-320
         else:
-            action_loss = jnp.mean(ce, axis=-1)
+            action_loss = jnp.mean(loss_terms, axis=-1)
         loss = jnp.mean(action_loss)  # harness loss_fn (distribute_train.py:112-118)
 
         out = {

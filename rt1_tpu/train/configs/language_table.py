@@ -28,6 +28,9 @@ def get_config():
     config.model.image_tokenizer = "efficientnet_b3"
     config.model.dtype = "bfloat16"
     config.model.photometric_augmentation = False
+    # Focal CE modulation (models/rt1.py): 0 = reference parity; > 0 fights
+    # the BC marginal-collapse ("copycat") failure on smooth oracle demos.
+    config.model.focal_gamma = 0.0
     # Decoder FFN: "dense" (reference parity) or "moe" (Switch expert FFN,
     # expert-parallel over the mesh's 'model' axis — models/moe.py).
     config.model.ffn_impl = "dense"

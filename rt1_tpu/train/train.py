@@ -80,6 +80,7 @@ def build_model(model_config):
         photometric_augmentation=model_config.get(
             "photometric_augmentation", False
         ),
+        focal_gamma=model_config.get("focal_gamma", 0.0),
         # Opt-in Switch MoE decoder FFN (models/moe.py); "dense" is
         # reference parity.
         ffn_impl=model_config.get("ffn_impl", "dense"),

@@ -49,6 +49,19 @@ flags.DEFINE_integer("height", 128, "Train/eval image height.")
 flags.DEFINE_integer("width", 224, "Train/eval image width.")
 flags.DEFINE_integer("batch", 32, "Per-host batch size.")
 flags.DEFINE_integer("checkpoint_every", 2500, "Checkpoint cadence (steps).")
+flags.DEFINE_integer(
+    "seq_len", 6,
+    "time_sequence_length. 1 = Markovian policy (current frame only) — the "
+    "scale-independent mitigation for the round-2 copycat-BC failure: the "
+    "RRT push oracle is state-feedback, so a history-free policy can match "
+    "it while having no motion-continuation shortcut to collapse onto.")
+flags.DEFINE_float(
+    "focal_gamma", 0.0,
+    "Focal CE modulation (models/rt1.py); 0 = reference parity.")
+flags.DEFINE_enum(
+    "dtype", "bfloat16", ["bfloat16", "float32"],
+    "Model compute dtype. bfloat16 on TPU; float32 is ~1.4x faster on the "
+    "CPU fallback (oneDNN emulates bf16).")
 
 REWARD = "block2block"
 EVAL_SEED = 10_000  # disjoint from collection worker seeds (0..workers)
@@ -59,6 +72,9 @@ def get_train_config(data_dir, num_steps):
 
     config = language_table.get_config()
     config.model.image_tokenizer = FLAGS.image_tokenizer
+    config.model.time_sequence_length = FLAGS.seq_len
+    config.model.focal_gamma = FLAGS.focal_gamma
+    config.model.dtype = FLAGS.dtype
     config.data.data_dir = data_dir
     config.data.height = FLAGS.height
     config.data.width = FLAGS.width
@@ -98,6 +114,38 @@ def stage_collect():
     return data_dir
 
 
+# Model/data identity of a checkpoint: a mismatch silently restores into the
+# wrong model (no parameter shape depends on e.g. time_sequence_length — the
+# positional embedding is fixed at max(256, tokens)) and records garbage
+# success rates attributed to the wrong config.
+EVAL_META_KEYS = (
+    "seq_len", "image_tokenizer", "height", "width", "dtype", "focal_gamma",
+    "embedder",
+)
+# batch additionally matters when *resuming training* (optimizer/data order),
+# but params are batch-independent, so eval may legitimately differ.
+TRAIN_META_KEYS = EVAL_META_KEYS + ("batch",)
+
+
+def _check_train_meta(train_dir, context, keys):
+    path = os.path.join(train_dir, "train_meta.json")
+    if not os.path.exists(path):
+        print(f"{context}: no train_meta.json (pre-r3 workdir); skipping check")
+        return
+    with open(path) as f:
+        recorded = json.load(f)
+    mismatches = {
+        k: (recorded[k], getattr(FLAGS, k))
+        for k in keys
+        if k in recorded and recorded[k] != getattr(FLAGS, k)
+    }
+    if mismatches:
+        raise ValueError(
+            f"{context}: flags disagree with the checkpoint's training config "
+            f"{path}: {mismatches}. Pass the training-time flags (or retrain)."
+        )
+
+
 def stage_train(data_dir):
     from rt1_tpu.train.train import train_and_evaluate
 
@@ -108,6 +156,18 @@ def stage_train(data_dir):
         print(f"train: already done (step {latest})")
         return train_dir
     config = get_train_config(data_dir, FLAGS.num_steps)
+    os.makedirs(train_dir, exist_ok=True)
+    if latest is not None:
+        # Resuming real checkpoints: the recorded config is ground truth
+        # (never restamped — a pre-r3 workdir without the file stays
+        # unstamped rather than trusting the current flags).
+        _check_train_meta(train_dir, "train(resume)", TRAIN_META_KEYS)
+    else:
+        # Fresh start: (re)stamp, clobbering any stale meta from a run that
+        # crashed before its first checkpoint.
+        with open(os.path.join(train_dir, "train_meta.json"), "w") as f:
+            json.dump({k: getattr(FLAGS, k) for k in TRAIN_META_KEYS}, f,
+                      indent=2)
     train_and_evaluate(config, train_dir)
     return train_dir
 
@@ -184,7 +244,7 @@ def _run_protocol(policy, tag):
         embedder=FLAGS.embedder,
         env_kwargs=dict(
             target_height=FLAGS.height, target_width=FLAGS.width,
-            sequence_length=6
+            sequence_length=FLAGS.seq_len
         ),
     )
     successes = results["successes"][REWARD]
@@ -233,6 +293,7 @@ def _plot_curves(curves, path):
 def stage_eval(train_dir, data_dir):
     from rt1_tpu.data.collect import check_embedder_compatibility
 
+    _check_train_meta(train_dir, "eval", EVAL_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="eval")
     policy = _restore_policy(train_dir, data_dir)
     trained = _run_protocol(policy, "trained")
@@ -247,6 +308,10 @@ def stage_eval(train_dir, data_dir):
         "embedder": FLAGS.embedder,
         "episodes_collected": FLAGS.episodes,
         "train_steps": FLAGS.num_steps,
+        "seq_len": FLAGS.seq_len,
+        "focal_gamma": FLAGS.focal_gamma,
+        "image_tokenizer": FLAGS.image_tokenizer,
+        "resolution": [FLAGS.height, FLAGS.width],
         "eval_episodes": FLAGS.eval_episodes,
         "trained_successes": trained["successes"][REWARD],
         "random_successes": random_results["successes"][REWARD],
