@@ -71,7 +71,9 @@ def main():
             )
             # 0.0 with vs_baseline 0.0 is the "no chip" sentinel for
             # throughput metrics; for latency (lower-better) use inf-like
-            # -1.0 so it can't read as a perfect run.
+            # -1.0 so it can't read as a perfect run. The explicit "error"
+            # field keeps automation that parses the JSON line from
+            # recording the wedge as a real measurement.
             value = -1.0 if args.mode == "infer" else 0.0
             print(
                 json.dumps(
@@ -80,6 +82,7 @@ def main():
                         "value": value,
                         "unit": metric[1],
                         "vs_baseline": 0.0,
+                        "error": "chip_unclaimable",
                     }
                 )
             )

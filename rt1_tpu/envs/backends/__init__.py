@@ -1,29 +1,35 @@
 """Pluggable physics backends for the Language-Table board.
 
 The reference runs exclusively on PyBullet (`language_table.py:41-42`); we
-abstract the physics so the env also runs hermetically (pure numpy) where
-PyBullet isn't installed. `make_backend("auto")` prefers PyBullet when
-importable, else the kinematic backend.
+abstract the physics behind a small backend contract (pose get/set,
+deterministic stepping, bit-exact state snapshots — see
+tests/test_backends.py) so the env runs hermetically on pure numpy.
+
+**PyBullet backend: retired (round 3).** pybullet is not installable in
+this image and its URDF assets are not bundled, so a PyBullet backend could
+never execute here — an unverifiable backend is risk masquerading as
+coverage (it was the test suite's only skips). The decision and the
+re-introduction path (the backend contract any new physics engine must
+satisfy) are recorded in docs/physics.md. `make_backend("auto")` is kept as
+an alias for the default kinematic backend so reference-style call sites
+keep working.
 """
 
 from rt1_tpu.envs.backends.kinematic import KinematicBackend
 
 
 def make_backend(name="auto", **kwargs):
-    if name == "kinematic":
+    if name in ("kinematic", "auto"):
         return KinematicBackend(**kwargs)
     if name == "kinematic_arm":
         # xArm6 FK/IK in the control loop (reference arm-physics parity).
         return KinematicBackend(arm="kinematic", **kwargs)
-    if name in ("auto", "pybullet"):
-        try:
-            from rt1_tpu.envs.backends.pybullet_backend import PyBulletBackend
-
-            return PyBulletBackend(**kwargs)
-        except ImportError:
-            if name == "pybullet":
-                raise
-            return KinematicBackend(**kwargs)
+    if name == "pybullet":
+        raise ValueError(
+            "The PyBullet backend was retired in round 3 (pybullet is not "
+            "installable in this image; see docs/physics.md). Use "
+            "backend='kinematic' or 'kinematic_arm'."
+        )
     raise ValueError(f"Unknown physics backend: {name}")
 
 

@@ -103,7 +103,13 @@ def generate_runtime_instructions(block_mode):
     ):
         extend(family.runtime_instructions(block_mode))
     if block_mode == blocks.BlockMode.BLOCK_4:
-        extend(play.get_100_4block_instructions(num_train_per_family=20))
+        # Same split constant as PlayReward's sampler — never hardcode a
+        # number here (a mismatch silently uncovers play instructions).
+        extend(
+            play.get_100_4block_instructions(
+                num_train_per_family=play.NUM_TRAIN_PER_FAMILY
+            )
+        )
     return out
 
 

@@ -82,7 +82,15 @@ def _expand(seeded):
     return out
 
 
-def get_100_4block_instructions(num_train_per_family=20,
+# The train-split size per long-horizon family. Single source of truth for
+# BOTH the sampler (PlayReward below) and the runtime embedding table
+# (rewards.generate_runtime_instructions): if the two disagreed, a table
+# embedder would silently miss play instructions at reset and the policy
+# would get a stale/KeyError embedding mid-eval.
+NUM_TRAIN_PER_FAMILY = 20
+
+
+def get_100_4block_instructions(num_train_per_family=NUM_TRAIN_PER_FAMILY,
                                 num_test_per_family=5,
                                 return_train=True):
     """20 random train (+5 test) instructions per long-horizon family."""
@@ -287,7 +295,7 @@ class PlayReward(base.BoardReward):
         self.block_mode = block_mode.value
         if self.block_mode == "BLOCK_4":
             self._all_instructions = get_100_4block_instructions(
-                num_train_per_family=20
+                num_train_per_family=NUM_TRAIN_PER_FAMILY
             )
 
     def _sample_instruction(self, start_block, target_block, blocks_on_table):

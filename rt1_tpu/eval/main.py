@@ -153,7 +153,7 @@ if __name__ == "__main__":
     flags.DEFINE_string(
         "backend", "kinematic",
         "Physics backend: kinematic | kinematic_arm (xArm6 IK in the "
-        "loop) | pybullet | auto.")
+        "loop) | auto.")
     flags.DEFINE_bool("videos", False, "Write episode videos.")
     flags.DEFINE_bool(
         "allow_embedder_mismatch", False,

@@ -81,11 +81,17 @@ class MoEFeedForward(nn.Module):
         )                               # rows are all-zero → token dropped
         dispatch = one_hot[:, :, None] * pos_one_hot[:, None, :]  # (t, e, c)
 
+        # batch_axis=0: the leading expert axis is independent replicas, not
+        # a receptive-field dim — plain lecun_normal would count fan_in as
+        # E·d and under-scale every expert by ~sqrt(E) (Switch init recipe).
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", batch_axis=(0,)
+        )
         wi = self.param(
-            "wi", nn.initializers.lecun_normal(), (e, d, ff), jnp.float32
+            "wi", expert_init, (e, d, ff), jnp.float32
         ).astype(self.dtype)
         wo = self.param(
-            "wo", nn.initializers.lecun_normal(), (e, ff, d), jnp.float32
+            "wo", expert_init, (e, ff, d), jnp.float32
         ).astype(self.dtype)
 
         dispatch = dispatch.astype(self.dtype)

@@ -125,6 +125,14 @@ class RT1Policy(nn.Module):
     moe_capacity_factor: float = 2.0
     moe_ff_dim: Optional[int] = None
     moe_aux_weight: float = 0.01
+    # Pipeline parallelism: when `mesh` has a >1 "stage" axis, the decoder's
+    # layer stack runs GPipe-pipelined over it (parallel/pipeline.py) with
+    # this many microbatches per step; per-(layer, microbatch) dropout rngs
+    # are folded from the "dropout" stream. Param layout is unchanged
+    # (checkpoints are stage-count-portable); parameters stay replicated —
+    # PP here scales *compute* across chips, which at RT-1 size (decoder
+    # ~17M params) is the binding constraint, not parameter memory.
+    pipeline_microbatches: int = 4
     # Optional custom image tokenizer module (must map (b,t,H,W,3), (b,t,D) →
     # (b,t,num_image_tokens,token_embedding_size)); used by tests to swap the
     # EfficientNet-B3 backbone for a tiny one.
@@ -233,9 +241,38 @@ class RT1Policy(nn.Module):
         seq = jnp.concatenate([context_image_tokens, action_slots], axis=2)
         return seq.reshape(b, t * self.single_step_tokens, e)
 
+    def _pipeline_enabled(self) -> bool:
+        return (
+            self.mesh is not None
+            and getattr(self.mesh, "shape", {}).get("stage", 1) > 1
+        )
+
     def _transformer_logits(self, context_image_tokens: jnp.ndarray, train: bool):
         seq = self._assemble(context_image_tokens)
         mask = jnp.asarray(self._mask)
+        if self._pipeline_enabled() and not self.is_initializing():
+            # GPipe path: same params, layer stack pipelined over the mesh's
+            # "stage" axis. Init still runs the sequential module (below) so
+            # the param tree is identical either way.
+            if self.return_attention_scores:
+                raise ValueError(
+                    "attention scores are not materialized under pipeline "
+                    "parallelism; use a stage=1 mesh for score visualization"
+                )
+            from rt1_tpu.parallel.pipeline import pp_causal_transformer_apply
+
+            use_dropout = train and self.dropout_rate > 0
+            logits = pp_causal_transformer_apply(
+                self.transformer,
+                {"params": self.transformer.variables["params"]},
+                seq,
+                mesh=self.mesh,
+                num_microbatches=self.pipeline_microbatches,
+                attention_mask=mask,
+                train=train,
+                dropout_rng=self.make_rng("dropout") if use_dropout else None,
+            )
+            return logits, None
         out = self.transformer(seq, attention_mask=mask, train=train)
         if self.return_attention_scores:
             return out  # (logits, scores)

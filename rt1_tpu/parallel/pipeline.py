@@ -60,7 +60,7 @@ def unstack_layer_params(stacked: Any, prefix: str = "layer_") -> dict:
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[..., jnp.ndarray],
     stacked_params: Any,
     x: jnp.ndarray,
     *,
@@ -68,6 +68,7 @@ def pipeline_apply(
     num_microbatches: int,
     stage_axis: str = "stage",
     data_axis: Optional[str] = "data",
+    pass_context: bool = False,
 ) -> jnp.ndarray:
     """Run ``x`` through S pipelined stages; returns the final activations.
 
@@ -77,22 +78,34 @@ def pipeline_apply(
       sharded over it (each data row runs an independent pipeline down its
       own stage column). The per-shard batch must divide `num_microbatches`.
     * Output == sequentially applying all L layers (exact; no renorm).
+    * ``pass_context``: call ``stage_fn(p, x, layer_idx, microbatch_idx)``
+      instead of ``stage_fn(p, x)`` — the hook that lets training fold a
+      dropout rng per (layer, microbatch). Both indices are traced int32
+      scalars (global layer index; microbatch index clamped to [0, M) on
+      bubble ticks, whose outputs are discarded).
 
     Differentiable: the backward pass pipelines in reverse through the same
     scan/ppermute structure via autodiff.
     """
+    M = num_microbatches
     S = mesh.shape[stage_axis]
     if S == 1:  # degenerate: plain scan over the stack, no collectives
-        def fold(x, p):
-            return stage_fn(p, x), None
+        L1 = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
 
-        out, _ = jax.lax.scan(fold, x, stacked_params)
+        def fold(x, p_i):
+            p, i = p_i
+            y = stage_fn(p, x, i, jnp.zeros((), jnp.int32)) if pass_context \
+                else stage_fn(p, x)
+            return y, None
+
+        out, _ = jax.lax.scan(
+            fold, x, (stacked_params, jnp.arange(L1, dtype=jnp.int32))
+        )
         return out
 
     L = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if L % S != 0:
         raise ValueError(f"{L} stacked layers not divisible by {S} stages")
-    M = num_microbatches
     batch_spec = (
         P(data_axis)
         if data_axis and mesh.shape.get(data_axis, 1) > 1
@@ -114,23 +127,38 @@ def pipeline_apply(
         pad = jnp.zeros((S - 1,) + feed.shape[1:], feed.dtype)
         feed = jnp.concatenate([feed, pad], axis=0)  # (T, mb, ...)
 
-        def run_stage(x_in):
-            def fold(x, p):
-                return stage_fn(p, x), None
+        layers_per_stage = L // S
 
-            out, _ = jax.lax.scan(fold, x_in, params_chunk)
+        def run_stage(x_in, m_idx):
+            def fold(x, p_l):
+                p, l = p_l
+                if pass_context:
+                    y = stage_fn(p, x, s_idx * layers_per_stage + l, m_idx)
+                else:
+                    y = stage_fn(p, x)
+                return y, None
+
+            out, _ = jax.lax.scan(
+                fold, x_in,
+                (params_chunk, jnp.arange(layers_per_stage, dtype=jnp.int32)),
+            )
             return out
 
         rotate = [(i, (i + 1) % S) for i in range(S)]
 
-        def tick(prev_y, x_t):
+        def tick(prev_y, x_t_and_t):
+            x_t, t = x_t_and_t
             incoming = jax.lax.ppermute(prev_y, stage_axis, rotate)
             x_in = jnp.where(s_idx == 0, x_t, incoming)
-            y = run_stage(x_in)
+            # Stage s processes microbatch t - s at tick t (clamped on the
+            # warm-up/drain bubbles, whose outputs never leave the mask).
+            m_idx = jnp.clip(t - s_idx, 0, M - 1).astype(jnp.int32)
+            y = run_stage(x_in, m_idx)
             return y, y
 
         y0 = jnp.zeros(feed.shape[1:], feed.dtype)
-        _, ys = jax.lax.scan(tick, y0, feed)  # (T, mb, ...)
+        ticks = jnp.arange(feed.shape[0], dtype=jnp.int32)
+        _, ys = jax.lax.scan(tick, y0, (feed, ticks))  # (T, mb, ...)
         # Microbatch m exits the last stage at tick S-1+m. Replicate the
         # last stage's results to every stage with a masked psum so the
         # caller sees identical activations on all shards.
@@ -157,16 +185,24 @@ def pp_causal_transformer_apply(
     num_microbatches: int,
     attention_mask: Optional[jnp.ndarray] = None,
     stage_axis: str = "stage",
+    train: bool = False,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """`CausalTransformer.__call__` with the layer stack pipelined.
 
     ``transformer`` is the `CausalTransformer` module instance (for its
     hyperparameters), ``params`` its standard Flax params. Embedding, the
     positional table, and the vocab head run replicated; the N pre-norm
-    blocks run under `pipeline_apply`. Deterministic (train=False) — dropout
-    inside a pipelined stage would need per-stage rng plumbing; training
-    with PP uses the same structure with `rngs` folded into the stage id,
-    which is left to the trainer integration.
+    blocks run under `pipeline_apply` (the sequential module has no dropout
+    outside the blocks, so this split is train-exact too).
+
+    Training: pass ``train=True`` and a ``dropout_rng``; each (layer,
+    microbatch) folds its indices into the rng, so masks are independent
+    across layers and microbatches. This matches the sequential module's
+    dropout *distribution* (every activation element keeps an independent
+    Bernoulli mask) but not its bitstream — with `dropout_rate > 0` the
+    pipelined and sequential losses are equal in expectation, not bitwise;
+    exactness tests must set `dropout_rate = 0`.
 
     MoE caveat (``ffn_impl="moe"``): expert capacity is computed over the
     tokens of each *forward call*, so under PP it binds per microbatch
@@ -174,7 +210,10 @@ def pp_causal_transformer_apply(
     semantics of MoE systems. Outputs match the sequential module exactly
     whenever no expert overflows its capacity (e.g. capacity_factor ≥
     num_experts guarantees it for top-1 routing); when drops do occur, the
-    two schedules may drop different tokens.
+    two schedules may drop different tokens. *Training* under PP+MoE is
+    rejected: the Switch load-balancing aux loss is sown via `self.sow`,
+    which an unmutable `layer.apply` inside the stage silently discards —
+    training would lose the regularizer and invite router collapse.
     """
     from rt1_tpu.models.transformer import TransformerLayer
 
@@ -190,6 +229,18 @@ def pp_causal_transformer_apply(
             "pipeline parallelism supports attention_impl='dense' only, "
             f"got {transformer.attention_impl!r}"
         )
+    if train and transformer.ffn_impl == "moe":
+        raise ValueError(
+            "training with pipeline parallelism + MoE FFN is unsupported: "
+            "the Switch aux loss sown inside the stage would be discarded "
+            "(no mutable collections cross the shard_map); use ffn_impl="
+            "'dense' under PP or train MoE on a stage=1 mesh"
+        )
+    use_dropout = train and transformer.dropout_rate > 0
+    if use_dropout and dropout_rng is None:
+        raise ValueError(
+            "train=True with dropout_rate > 0 requires dropout_rng"
+        )
     layer = TransformerLayer(
         key_dim=transformer.key_dim,
         num_heads=transformer.num_heads,
@@ -200,11 +251,32 @@ def pp_causal_transformer_apply(
         num_experts=transformer.num_experts,
         moe_capacity_factor=transformer.moe_capacity_factor,
         moe_ff_dim=transformer.moe_ff_dim,
+        # Detach from any enclosing module context: this is a stateless
+        # stage template applied with explicit params, not a submodule
+        # (RT1Policy calls this helper from inside its own apply).
+        parent=None,
     )
 
-    def stage_fn(layer_params, h):
+    # Inside the shard_map each data row is a different slice of the batch,
+    # so the mask must differ per data shard too (folding only layer/micro
+    # would reuse one mask across all data rows, shrinking effective dropout
+    # noise as DP grows). axis_index is only bindable under the shard_map,
+    # i.e. on the S > 1 path; the degenerate S == 1 path runs unsharded.
+    fold_data = (
+        mesh.shape[stage_axis] > 1 and mesh.shape.get("data", 1) > 1
+    )
+
+    def stage_fn(layer_params, h, layer_idx, mb_idx):
+        rngs = None
+        if use_dropout:
+            r = jax.random.fold_in(dropout_rng, layer_idx)
+            r = jax.random.fold_in(r, mb_idx)
+            if fold_data:
+                r = jax.random.fold_in(r, jax.lax.axis_index("data"))
+            rngs = {"dropout": r}
         out, _ = layer.apply(
-            {"params": layer_params}, h, mask=attention_mask, train=False
+            {"params": layer_params}, h, mask=attention_mask, train=train,
+            rngs=rngs,
         )
         return out
 
@@ -216,5 +288,6 @@ def pp_causal_transformer_apply(
         mesh=mesh,
         num_microbatches=num_microbatches,
         stage_axis=stage_axis,
+        pass_context=True,
     )
     return x @ p["output_tokens"]["kernel"] + p["output_tokens"]["bias"]

@@ -31,6 +31,11 @@ def get_config():
     # Focal CE modulation (models/rt1.py): 0 = reference parity; > 0 fights
     # the BC marginal-collapse ("copycat") failure on smooth oracle demos.
     config.model.focal_gamma = 0.0
+    # Attention implementation: "dense" (reference parity), "ring" (sequence-
+    # parallel over the mesh's 'seq' axis), "pallas" (fused inference kernel).
+    config.model.attention_impl = "dense"
+    # GPipe microbatches per step when mesh.stage > 1 (parallel/pipeline.py).
+    config.model.pipeline_microbatches = 4
     # Decoder FFN: "dense" (reference parity) or "moe" (Switch expert FFN,
     # expert-parallel over the mesh's 'model' axis — models/moe.py).
     config.model.ffn_impl = "dense"
@@ -102,6 +107,9 @@ def get_config():
     config.mesh.data = -1
     config.mesh.model = 1
     config.mesh.seq = 1
+    # Pipeline stages (GPipe over the decoder's layer stack); num_layers
+    # must be divisible by this.
+    config.mesh.stage = 1
 
     # Checkpoint / logging cadence.
     config.checkpoint_every_steps = 975

@@ -38,7 +38,14 @@ from rt1_tpu.trainer.metrics import (
 )
 
 
-def build_model(model_config):
+def build_model(model_config, mesh=None):
+    """Construct the RT-1 policy from `config.model`.
+
+    `mesh` enables mesh-coupled features: a >1 "stage" axis pipelines the
+    decoder (GPipe, parallel/pipeline.py), a >1 "seq" axis is required for
+    attention_impl="ring". Eval/restore callers may omit it — parameter
+    layout does not depend on the mesh.
+    """
     from rt1_tpu.models.rt1 import RT1Policy
 
     tokenizer_def = None
@@ -81,6 +88,9 @@ def build_model(model_config):
             "photometric_augmentation", False
         ),
         focal_gamma=model_config.get("focal_gamma", 0.0),
+        attention_impl=model_config.get("attention_impl", "dense"),
+        mesh=mesh,
+        pipeline_microbatches=model_config.get("pipeline_microbatches", 4),
         # Opt-in Switch MoE decoder FFN (models/moe.py); "dense" is
         # reference parity.
         ffn_impl=model_config.get("ffn_impl", "dense"),
@@ -94,7 +104,7 @@ def build_model(model_config):
     )
 
 
-def build_family(model_config):
+def build_family(model_config, mesh=None):
     """(model, init_fn, loss_fn) for config.model.family = "rt1" | "lava".
 
     The reference trains its two model families from separate stacks
@@ -105,7 +115,16 @@ def build_family(model_config):
     """
     family = model_config.get("family", "rt1")
     if family == "rt1":
-        return build_model(model_config), None, None
+        return build_model(model_config, mesh=mesh), None, None
+    if (
+        mesh is not None
+        and getattr(mesh, "shape", {}).get("stage", 1) > 1
+    ):
+        raise ValueError(
+            f"mesh.stage > 1 (pipeline parallelism) is only supported for "
+            f"the 'rt1' family; family={family!r} would silently replicate "
+            f"all compute across the stage axis"
+        )
     if family == "lava":
         from rt1_tpu.models.lava import SequenceLAVMSE
         from rt1_tpu.trainer.bc import adapt_obs_for_lava, make_bc_step_loss_fn
@@ -301,20 +320,34 @@ def train_and_evaluate(config, workdir: str):
     write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
 
     _check_clip_token_config(config)
-    model, init_fn, loss_fn = build_family(config.model)
     mesh = make_mesh(
         MeshConfig(
             data=config.mesh.data,
             model=config.mesh.model,
             seq=config.mesh.seq,
+            stage=config.mesh.get("stage", 1),
         )
     )
+    model, init_fn, loss_fn = build_family(config.model, mesh=mesh)
     data_size = mesh.shape["data"]
     if config.per_host_batch_size % data_size != 0:
         raise ValueError(
             f"per_host_batch_size={config.per_host_batch_size} must be "
             f"divisible by the mesh data axis ({data_size} devices)"
         )
+    if mesh.shape["stage"] > 1:
+        accum = max(int(config.get("accum_steps", 1)), 1)
+        # Each accumulation microstep forwards batch/accum rows, sharded
+        # over data — that is the batch pipeline_apply actually sees.
+        shard_batch = config.per_host_batch_size // data_size // accum
+        micro = config.model.get("pipeline_microbatches", 4)
+        if shard_batch == 0 or shard_batch % micro != 0:
+            raise ValueError(
+                f"pipeline parallelism: per-data-shard per-accum-step batch "
+                f"{shard_batch} (= {config.per_host_batch_size} / "
+                f"{data_size} data shards / {accum} accum steps) must be a "
+                f"positive multiple of pipeline_microbatches={micro}"
+            )
 
     if config.data.data_dir:
         train_iter = dataset_batches(config, "train")

@@ -159,6 +159,17 @@ def main():
             json.dump(results, f, indent=2)
 
     if not args.skip_bench:
+        def chip_related(headline):
+            """Only wait out the wedge for chip-shaped failures; a code bug
+            or JSON parse error would otherwise burn ~40 min of probing per
+            failed mode for nothing."""
+            err = str((headline or {}).get("error", ""))
+            return any(
+                s in err
+                for s in ("timed out", "UNAVAILABLE", "chip_unclaimable",
+                          "DEADLINE_EXCEEDED")
+            )
+
         for mode in ("train", "e2e", "mfu"):
             headline, detail = run_bench(mode)
             results[f"bench_{mode}"] = headline
@@ -166,7 +177,7 @@ def main():
                 results[f"bench_{mode}_detail"] = detail
             print(mode, "->", headline, flush=True)
             checkpoint_results()
-            if "error" in (headline or {}):
+            if chip_related(headline):
                 wait_for_chip()
 
         for impl in ("dense", "pallas"):
@@ -174,7 +185,7 @@ def main():
             results[f"bench_infer_{impl}"] = headline
             print("infer", impl, "->", headline, flush=True)
             checkpoint_results()
-            if "error" in (headline or {}):
+            if chip_related(headline):
                 wait_for_chip()
 
     # Device inventory via a short-lived subprocess, independent of the ring

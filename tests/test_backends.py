@@ -4,8 +4,9 @@ Mirrors the reference's env behavioral tests
 (`language_table/environments/language_table_test.py:27-80`) at the backend
 seam: every registered backend must satisfy the same pose get/set,
 deterministic stepping, and bit-exact state save/restore contract, so the
-env can switch backends without behavioral surprises. PyBullet is skipped
-automatically when the package/assets are absent (as in this image).
+env can switch backends without behavioral surprises. This contract is also
+the re-introduction bar for any future physics engine (the PyBullet backend
+was retired in round 3 — docs/physics.md).
 """
 
 import numpy as np
@@ -15,26 +16,20 @@ from rt1_tpu.envs import constants
 
 
 def _make(spec):
-    import os
-
     from rt1_tpu.envs.backends import make_backend
 
-    if spec == "pybullet":
-        pb = pytest.importorskip("pybullet")
-        # The URDF asset tree isn't bundled; point LT_ASSET_ROOT at one to
-        # run the contract suite against real PyBullet.
-        try:
-            return make_backend(
-                "pybullet", asset_root=os.environ.get("LT_ASSET_ROOT")
-            )
-        except (ValueError, FileNotFoundError, OSError, pb.error) as e:
-            # Expected unavailability (no asset root / missing URDFs) only —
-            # genuine backend regressions must fail, not skip.
-            pytest.skip(f"pybullet backend unavailable: {e}")
     return make_backend(spec)
 
 
-BACKENDS = ["kinematic", "kinematic_arm", "pybullet"]
+BACKENDS = ["kinematic", "kinematic_arm"]
+
+
+def test_pybullet_backend_retired():
+    """The retirement is explicit, not a silent fallback (docs/physics.md)."""
+    from rt1_tpu.envs.backends import make_backend
+
+    with pytest.raises(ValueError, match="retired"):
+        make_backend("pybullet")
 
 
 @pytest.fixture(params=BACKENDS)

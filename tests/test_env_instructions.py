@@ -117,6 +117,24 @@ def test_runtime_instructions_cover_all_samplers():
             )
 
 
+def test_play_sampler_split_matches_runtime_table():
+    """The play sampler and the runtime table must share one split constant:
+    every instruction PlayReward can draw (its train split) is in the table,
+    regardless of what NUM_TRAIN_PER_FAMILY is set to."""
+    from rt1_tpu.envs import blocks
+    from rt1_tpu.envs import rewards as rewards_module
+    from rt1_tpu.envs.rewards import play
+
+    table = set(
+        rewards_module.generate_runtime_instructions(blocks.BlockMode.BLOCK_4)
+    )
+    sampler_pool = play.get_100_4block_instructions(
+        num_train_per_family=play.NUM_TRAIN_PER_FAMILY
+    )
+    missing = set(sampler_pool) - table
+    assert not missing, sorted(missing)[:5]
+
+
 def test_runtime_superset_of_reference_enumeration():
     from rt1_tpu.envs import blocks
     from rt1_tpu.envs import rewards as rewards_module
