@@ -73,8 +73,40 @@ def _group_torch(state_dict) -> List[Tuple[str, str, Dict[str, np.ndarray]]]:
     return groups
 
 
+def _structural_key(path: Tuple) -> Tuple:
+    """Sort key putting EfficientNet modules in ARCHITECTURAL order.
+
+    Dict iteration order is not trustworthy here: a fresh `model.init`
+    yields construction order, but any pytree round-trip — `jax.eval_shape`,
+    jit output reconstruction, an **Orbax checkpoint restore** — returns
+    keys string-sorted ('block_10' before 'block_2'). The ordered-zip
+    alignment must therefore be derived from the architecture, not from
+    whatever order the dict happens to carry.
+    """
+    order_top = {"stem": 0, "top": 2, "classifier": 3}
+    order_in_block = {"expand": 0, "depthwise": 1, "se": 2, "project": 3}
+    order_se = {"fc1": 0, "fc2": 1}
+    order_cna = {"conv": 0, "bn": 1}  # within a ConvNormAct
+    key: List = []
+    for part in path:
+        name = str(part)
+        if name.startswith("block_") and name[6:].isdigit():
+            key.append((1, int(name[6:]), ""))
+        elif name in order_top:
+            key.append((order_top[name], -1, ""))
+        elif name in order_in_block:
+            key.append((order_in_block[name], -1, ""))
+        elif name in order_se:
+            key.append((order_se[name], -1, ""))
+        elif name in order_cna:
+            key.append((order_cna[name], -1, ""))
+        else:
+            key.append((9, -1, name))  # unknown: stable alphabetical tail
+    return tuple(key)
+
+
 def _group_flax(params, batch_stats) -> List[Tuple[str, Tuple, Dict]]:
-    """[(kind, path, leaves)] in construction order, FiLM layers skipped."""
+    """[(kind, path, leaves)] in ARCHITECTURAL order, FiLM layers skipped."""
     flat_params = flax.traverse_util.flatten_dict(params)
     flat_stats = flax.traverse_util.flatten_dict(batch_stats or {})
 
@@ -103,6 +135,7 @@ def _group_flax(params, batch_stats) -> List[Tuple[str, Tuple, Dict]]:
             groups.append(("linear", parent, leaves))
         else:
             raise ValueError(f"Unrecognized flax module at {parent}")
+    groups.sort(key=lambda g: _structural_key(g[1]))
     return groups
 
 

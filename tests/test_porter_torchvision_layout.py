@@ -1,0 +1,219 @@
+"""Porter validation against torchvision's REAL state-dict layout.
+
+VERDICT r2 #3: the functional porter golden (tests/test_porter_golden.py)
+builds its torch mirror from the same `block_configs()` the flax model uses,
+so a *shared* misreading of torchvision's layout would pass both sides. This
+module closes that gap without network access or torchvision itself: the
+manifest below is generated from torchvision's own published builder
+algorithm (`torchvision/models/efficientnet.py`: `_efficientnet_conf`
+bneck_conf table, `_make_divisible` channel rounding, and the
+`features.{stage}.{i}.block.{j}` / `Conv2dNormActivation` /
+`SqueezeExcitation(fc1/fc2)` module naming), re-derived here independently
+of the repo's `EfficientNet.block_configs()`.
+
+Independent anchor: the manifest's learnable-parameter total must equal
+**12,233,232** — torchvision's published `efficientnet_b3` parameter count
+(torchvision model zoo, `EfficientNet_B3_Weights.IMAGENET1K_V1`). A
+mis-remembered channel width, squeeze ratio, repeat count, or a missing
+module cannot hit that number.
+
+The tests then require the porter to consume a state dict with EXACTLY this
+key order and these shapes — the layout contract the reference's blind
+ordered-zip load (`film_efficientnet_encoder.py:411-425`) silently assumes.
+Any divergence between the repo's architecture and torchvision's (one conv
+swapped, a BN missing, a squeeze width off) breaks the per-kind counts or a
+shape check and fails loudly.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+TORCHVISION_B3_PARAMS = 12_233_232  # published efficientnet_b3 total
+
+
+def _make_divisible(v, divisor=8):
+    """torchvision.models._utils._make_divisible (min_value=None path)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def torchvision_b3_manifest():
+    """Ordered [(key, shape)] of torchvision efficientnet_b3.state_dict().
+
+    Derived from torchvision's builder: width_mult 1.2 / depth_mult 1.4 over
+    the B0 MBConv table, SE squeeze = max(1, block_input_channels // 4),
+    head = 4 * last_channels, classifier Linear(head, 1000).
+    """
+    width, depth = 1.2, 1.4
+
+    def ch(c):
+        return _make_divisible(c * width)
+
+    def rep(r):
+        return int(math.ceil(r * depth))
+
+    # (expand, kernel, stride, in_base, out_base, repeats_base)
+    base = [
+        (1, 3, 1, 32, 16, 1),
+        (6, 3, 2, 16, 24, 2),
+        (6, 5, 2, 24, 40, 2),
+        (6, 3, 2, 40, 80, 3),
+        (6, 5, 1, 80, 112, 3),
+        (6, 5, 2, 112, 192, 4),
+        (6, 3, 1, 192, 320, 1),
+    ]
+
+    keys = []
+
+    def conv_norm_act(prefix, cin, cout, k, groups=1):
+        keys.append((f"{prefix}.0.weight", (cout, cin // groups, k, k)))
+        keys.append((f"{prefix}.1.weight", (cout,)))
+        keys.append((f"{prefix}.1.bias", (cout,)))
+        keys.append((f"{prefix}.1.running_mean", (cout,)))
+        keys.append((f"{prefix}.1.running_var", (cout,)))
+        keys.append((f"{prefix}.1.num_batches_tracked", ()))
+
+    def squeeze_excite(prefix, exp, sq):
+        keys.append((f"{prefix}.fc1.weight", (sq, exp, 1, 1)))
+        keys.append((f"{prefix}.fc1.bias", (sq,)))
+        keys.append((f"{prefix}.fc2.weight", (exp, sq, 1, 1)))
+        keys.append((f"{prefix}.fc2.bias", (exp,)))
+
+    stem = ch(32)
+    conv_norm_act("features.0", 3, stem, 3)
+    cin = stem
+    for stage, (e, k, _st, _bi, bo, r) in enumerate(base, start=1):
+        cout = ch(bo)
+        for i in range(rep(r)):
+            p = f"features.{stage}.{i}.block"
+            block_in = cin if i == 0 else cout
+            sq = max(1, block_in // 4)
+            exp = block_in * e
+            if e != 1:
+                conv_norm_act(f"{p}.0", block_in, exp, 1)          # expand
+                conv_norm_act(f"{p}.1", exp, exp, k, groups=exp)   # depthwise
+                squeeze_excite(f"{p}.2", exp, sq)
+                conv_norm_act(f"{p}.3", exp, cout, 1)              # project
+            else:
+                conv_norm_act(f"{p}.0", block_in, exp, k, groups=exp)
+                squeeze_excite(f"{p}.1", exp, sq)
+                conv_norm_act(f"{p}.2", exp, cout, 1)
+        cin = cout
+
+    head = 4 * ch(320)
+    conv_norm_act("features.8", cin, head, 1)
+    keys.append(("classifier.1.weight", (1000, head)))
+    keys.append(("classifier.1.bias", (1000,)))
+    return keys
+
+
+def test_manifest_matches_published_param_count():
+    """The independent anchor: learnable params == torchvision's 12,233,232."""
+    manifest = torchvision_b3_manifest()
+    learnable = sum(
+        math.prod(shape)
+        for key, shape in manifest
+        if "running_" not in key and "num_batches" not in key
+    )
+    assert learnable == TORCHVISION_B3_PARAMS
+    # Structure sanity pinned too: 26 MBConv blocks, stem 40, head 1536.
+    assert sum(1 for k, _ in manifest if k.endswith(".block.0.0.weight")) == 26
+    assert dict(manifest)["features.0.0.weight"] == (40, 3, 3, 3)
+    assert dict(manifest)["features.8.0.weight"] == (1536, 384, 1, 1)
+
+
+def _synthetic_state_dict(seed=0):
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for key, shape in torchvision_b3_manifest():
+        if key.endswith("num_batches_tracked"):
+            sd[key] = np.zeros(shape, np.int64)
+        elif key.endswith("running_var"):
+            sd[key] = rng.uniform(0.5, 1.5, shape).astype(np.float32)
+        else:
+            sd[key] = rng.standard_normal(shape).astype(np.float32) * 0.05
+    return sd
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("include_film", [False, True])
+def test_porter_consumes_real_torchvision_layout(include_film):
+    """A state dict with torchvision's exact key order and shapes ports into
+    the flax B3 (plain and FiLM variants) with every shape matching — the
+    test that fails when OUR architecture diverges from torchvision's, not
+    from its own mirror."""
+    import jax
+    import jax.numpy as jnp
+
+    from rt1_tpu.models.efficientnet import EfficientNetB3
+    from rt1_tpu.models.load_pretrained import port_torch_efficientnet
+
+    model = EfficientNetB3(include_top=True, include_film=include_film)
+    x = jnp.zeros((1, 64, 64, 3))
+    kwargs = {"context": jnp.zeros((1, 512))} if include_film else {}
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x, train=False, **kwargs)
+    )
+    # eval_shape gives ShapeDtypeStructs; materialize zeros cheaply (a full
+    # real init of B3 on one CPU core is ~40 s and adds nothing here).
+    variables = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), variables)
+
+    sd = _synthetic_state_dict()
+    ported = port_torch_efficientnet(sd, variables)
+
+    # Ordered-zip semantics: the FIRST torch conv (stem) must land in the
+    # flax stem kernel, OIHW -> HWIO transposed.
+    flat = {
+        "/".join(k): v
+        for k, v in __import__("flax").traverse_util.flatten_dict(
+            ported["params"]
+        ).items()
+    }
+    stem_key = next(k for k in flat if k.endswith("kernel") and flat[k].shape == (3, 3, 3, 40))
+    np.testing.assert_array_equal(
+        flat[stem_key],
+        np.transpose(sd["features.0.0.weight"], (2, 3, 1, 0)),
+    )
+    # And the classifier Linear transposes (1000, 1536) -> (1536, 1000).
+    cls_key = next(k for k in flat if flat[k].shape == (1536, 1000))
+    np.testing.assert_array_equal(
+        flat[cls_key], sd["classifier.1.weight"].T
+    )
+    # BN running stats route into batch_stats, not params.
+    stats_flat = __import__("flax").traverse_util.flatten_dict(
+        ported["batch_stats"]
+    )
+    means = [v for k, v in stats_flat.items() if k[-1] == "mean" and v.shape == (40,)]
+    assert any(
+        np.array_equal(m, sd["features.0.1.running_mean"]) for m in means
+    )
+
+
+@pytest.mark.slow
+def test_porter_rejects_layout_drift():
+    """Dropping one torchvision module breaks the per-kind count check —
+    the porter can never silently mis-zip a divergent layout."""
+    import jax
+    import jax.numpy as jnp
+
+    from rt1_tpu.models.efficientnet import EfficientNetB3
+    from rt1_tpu.models.load_pretrained import port_torch_efficientnet
+
+    model = EfficientNetB3(include_top=True)
+    variables = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False
+        )
+    )
+    variables = jax.tree.map(lambda s: np.zeros(s.shape, s.dtype), variables)
+
+    sd = _synthetic_state_dict()
+    for key in list(sd):
+        if key.startswith("features.3.1.block.2.fc1"):
+            del sd[key]
+    with pytest.raises(ValueError):
+        port_torch_efficientnet(sd, variables)
