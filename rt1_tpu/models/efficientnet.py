@@ -175,6 +175,13 @@ class EfficientNet(nn.Module):
     classes: int = 1000
     include_film: bool = False
     dtype: jnp.dtype = jnp.float32
+    # Rematerialize each MBConv block's activations in the backward pass
+    # (jax.checkpoint). The conv trunk dominates the train step's HBM
+    # footprint (b·t images deep in the tokenizer); remat trades ~1/3 more
+    # FLOPs for O(depth)→O(1) activation memory, buying batch headroom at
+    # 256×456. Semantics-preserving (loss/grads numerically identical;
+    # pinned by tests/test_vision.py::test_efficientnet_remat_grad_parity).
+    remat: bool = False
 
     def block_configs(self) -> Sequence[Dict[str, Any]]:
         """Flattened per-block args after width/depth scaling (reference `:283-318`)."""
@@ -212,8 +219,14 @@ class EfficientNet(nn.Module):
         stem_ch = round_filters(32, self.depth_divisor, self.width_coefficient)
         x = ConvNormAct(stem_ch, 3, strides=2, dtype=self.dtype, name="stem")(inputs, train)
 
+        # static_argnums counts `self` as 0: (self, inputs, train) → train=2.
+        block_cls = (
+            nn.remat(MBConvBlock, static_argnums=(2,))
+            if self.remat
+            else MBConvBlock
+        )
         for i, cfg in enumerate(self.block_configs()):
-            x = MBConvBlock(**cfg, dtype=self.dtype, name=f"block_{i}")(x, train)
+            x = block_cls(**cfg, dtype=self.dtype, name=f"block_{i}")(x, train)
             if self.include_film:
                 x = FilmConditioning(cfg["out_size"], dtype=self.dtype, name=f"film_{i}")(x, context)
 

@@ -26,6 +26,7 @@ class EfficientNetEncoder(nn.Module):
     # family at CPU-trainable cost (e.g. 0.35/0.35 ~ a MobileNet-size tower).
     width_coefficient: float = 1.2
     depth_coefficient: float = 1.4
+    remat: bool = False  # jax.checkpoint each MBConv block
 
     @nn.compact
     def __call__(
@@ -44,6 +45,7 @@ class EfficientNetEncoder(nn.Module):
             include_top=False,
             include_film=self.early_film,
             dtype=self.dtype,
+            remat=self.remat,
         )
         if self.early_film:
             features = net(image, context=context, train=train)

@@ -32,6 +32,7 @@ class RT1ImageTokenizer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     width_coefficient: float = 1.2   # B3 default
     depth_coefficient: float = 1.4
+    remat: bool = False  # jax.checkpoint the conv trunk (see EfficientNet)
 
     @nn.compact
     def __call__(
@@ -55,6 +56,7 @@ class RT1ImageTokenizer(nn.Module):
             dtype=self.dtype,
             width_coefficient=self.width_coefficient,
             depth_coefficient=self.depth_coefficient,
+            remat=self.remat,
             name="encoder",
         )(image, context=context, train=train)  # (B*T, h', w', E)
         if self.use_token_learner:

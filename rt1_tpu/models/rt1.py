@@ -133,6 +133,11 @@ class RT1Policy(nn.Module):
     # PP here scales *compute* across chips, which at RT-1 size (decoder
     # ~17M params) is the binding constraint, not parameter memory.
     pipeline_microbatches: int = 4
+    # Rematerialize transformer blocks AND MBConv blocks in the backward
+    # pass (jax.checkpoint): O(depth)→O(1) activation memory for ~1/3 extra
+    # FLOPs — batch-size headroom on HBM-bound flagship configs.
+    # Semantics-preserving (loss/grads unchanged; pinned in tests).
+    remat: bool = False
     # Optional custom image tokenizer module (must map (b,t,H,W,3), (b,t,D) →
     # (b,t,num_image_tokens,token_embedding_size)); used by tests to swap the
     # EfficientNet-B3 backbone for a tiny one.
@@ -165,6 +170,7 @@ class RT1Policy(nn.Module):
                 use_token_learner=self.use_token_learner,
                 num_tokens=self.num_image_tokens,
                 dtype=self.dtype,
+                remat=self.remat,
             )
         self.transformer = CausalTransformer(
             num_layers=self.num_layers,
@@ -185,6 +191,7 @@ class RT1Policy(nn.Module):
             num_experts=self.num_experts,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_ff_dim=self.moe_ff_dim,
+            remat=self.remat,
         )
         self._mask = rt1_attention_mask(
             self.time_sequence_length, self.tokens_per_image, self.tokens_per_action

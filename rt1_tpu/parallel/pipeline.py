@@ -241,7 +241,17 @@ def pp_causal_transformer_apply(
         raise ValueError(
             "train=True with dropout_rate > 0 requires dropout_rng"
         )
-    layer = TransformerLayer(
+    from flax import linen as _nn
+
+    # Honor the module's remat flag on the pipelined path too (otherwise
+    # remat=True + stage>1 would silently skip decoder rematerialization).
+    # static_argnums counts self as 0: (self, x, mask, train) → train=3.
+    layer_cls = (
+        _nn.remat(TransformerLayer, static_argnums=(3,))
+        if getattr(transformer, "remat", False)
+        else TransformerLayer
+    )
+    layer = layer_cls(
         key_dim=transformer.key_dim,
         num_heads=transformer.num_heads,
         d_model=transformer.d_model,
@@ -274,9 +284,10 @@ def pp_causal_transformer_apply(
             if fold_data:
                 r = jax.random.fold_in(r, jax.lax.axis_index("data"))
             rngs = {"dropout": r}
+        # Positional (x, mask, train): static_argnums on the remat wrap
+        # refers to positional indices.
         out, _ = layer.apply(
-            {"params": layer_params}, h, mask=attention_mask, train=train,
-            rngs=rngs,
+            {"params": layer_params}, h, attention_mask, train, rngs=rngs
         )
         return out
 

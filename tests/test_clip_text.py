@@ -197,6 +197,7 @@ def _lava_obs(rng):
     }
 
 
+@pytest.mark.slow
 def test_lava_clip_trains_with_frozen_tower():
     model = _lava_clip_model()
     rng = jax.random.PRNGKey(0)

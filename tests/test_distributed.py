@@ -23,6 +23,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_distributed(tmp_path):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")

@@ -137,6 +137,7 @@ def test_full_protocol_tiny(tiny_policy_setup):
     assert results["episodes_per_reward"] == 2
 
 
+@pytest.mark.slow
 def test_lava_eval_policy_paths():
     """LavaEvalPolicy: history slicing, clip tokenization from instruction
     bytes, action clipping (the Stack-B BCJaxPyPolicy role,

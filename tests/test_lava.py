@@ -32,6 +32,7 @@ def _obs(rng, h=H, w=W):
     }
 
 
+@pytest.mark.slow
 def test_sequence_lav_mse_conv_maxpool():
     model = SequenceLAVMSE(
         action_size=2,
@@ -55,6 +56,7 @@ def test_sequence_lav_mse_conv_maxpool():
     assert out_train.shape == (B, 2)
 
 
+@pytest.mark.slow
 def test_sequence_lav_mse_resnet_encoder():
     model = SequenceLAVMSE(
         action_size=2,
@@ -137,6 +139,7 @@ def test_remap_pretrained_params():
         remap_pretrained_params(params, pretrained, {"missing": "head"})
 
 
+@pytest.mark.slow
 def test_bc_loss_fn_end_to_end():
     model = PixelLangMSE(
         action_size=2, dense_resnet_width=32, dense_resnet_num_blocks=1

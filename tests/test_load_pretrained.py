@@ -14,6 +14,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-size compiles / heavy module fixture
+
 import flax
 
 from rt1_tpu.models.efficientnet import EfficientNet

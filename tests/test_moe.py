@@ -90,6 +90,7 @@ def test_expert_sharded_matches_single_device():
     )
 
 
+@pytest.mark.slow
 def test_moe_grads_finite_and_router_trains():
     t = CausalTransformer(
         num_layers=1, key_dim=4, num_heads=2, d_model=8, vocab_size=16,
@@ -109,6 +110,7 @@ def test_moe_grads_finite_and_router_trains():
     assert float(jnp.abs(gate_grad).sum()) > 0.0  # router receives gradient
 
 
+@pytest.mark.slow
 def test_rt1_moe_trains_with_aux_loss():
     """RT1Policy(ffn_impl='moe') through the real SPMD train step: the sown
     Switch aux loss reaches the training loss (trainer/_loss_fn wiring) and

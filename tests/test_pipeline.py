@@ -232,6 +232,7 @@ def test_pp_train_step_equals_dense():
     )
 
 
+@pytest.mark.slow
 def test_pp_train_step_with_dropout_runs():
     """Dropout under PP: per-(layer, microbatch) rngs fold inside the stage;
     the step must run and stay finite (bitwise parity with the sequential

@@ -15,6 +15,8 @@ transposed — changes the output and fails the allclose.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-size compiles / heavy module fixture
+
 torch = pytest.importorskip("torch")
 
 from rt1_tpu.models.efficientnet import EfficientNetB3, round_filters

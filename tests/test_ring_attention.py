@@ -83,6 +83,7 @@ def test_ring_rejects_indivisible_seq(seq_mesh):
         ring_attention(q[:, :30], k[:, :30], v[:, :30], seq_mesh, batch_axis=None)
 
 
+@pytest.mark.slow
 def test_ring_grad_flows(seq_mesh):
     q, k, v = _qkv(5)
     mask = jnp.tril(jnp.ones((T, T), jnp.int32))
@@ -102,6 +103,7 @@ def test_ring_grad_flows(seq_mesh):
     )
 
 
+@pytest.mark.slow
 def test_rt1_policy_ring_matches_dense(seq_mesh):
     """Full RT-1 forward with ring attention == dense attention loss.
 

@@ -134,6 +134,31 @@ def test_focal_gamma(policy_and_params, rng):
     assert any(float(np.max(np.abs(np.asarray(g)))) > 0 for g in flat)
 
 
+def test_remat_preserves_loss_and_grads(policy_and_params, rng):
+    """remat=True is a memory/compute trade, NOT a semantic change: loss and
+    gradients must match the stored-activation path. (The tiny tokenizer has
+    no MBConv blocks, so this exercises the transformer-side nn.remat; the
+    conv-side wrap is pinned by
+    tests/test_vision.py::test_efficientnet_remat_grad_parity.)"""
+    model, params = policy_and_params
+    obs, actions = make_batch(rng, b=2)
+    model_r = tiny_policy(remat=True)
+
+    def loss(m, p):
+        return m.apply(p, obs, actions, train=False)["loss"]
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(model, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(model_r, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5
+        ),
+        g0,
+        g1,
+    )
+
+
 def test_inference_state_machine(policy_and_params, rng):
     """Rolling-window inference over > T steps keeps shapes static and state sane."""
     model, params = policy_and_params

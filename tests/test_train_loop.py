@@ -39,6 +39,7 @@ def test_train_loop_synthetic_and_resume(tmp_path):
         np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.slow
 def test_train_loop_lava_family_and_resume(tmp_path):
     """One command trains LAVA: family switch through the same loop
     (reference Stack B `language_table/train/train.py:105-116`)."""
@@ -61,6 +62,7 @@ def test_train_loop_lava_family_and_resume(tmp_path):
         np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.slow
 def test_collect_then_train_lava_clip(tmp_path):
     """Full LAVA-with-CLIP lifecycle: oracle demos (instruction text stored)
     -> windowed pipeline emitting CLIP BPE tokens -> in-graph text tower.
@@ -141,6 +143,7 @@ def test_metrics_helpers(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_collect_lifecycle(tmp_path):
     """collect -> real-data train: the hermetic data-generation path."""
     from rt1_tpu.data.collect import collect_dataset

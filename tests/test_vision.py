@@ -144,3 +144,36 @@ def test_image_tokenizer_no_token_learner(rng):
     variables = tok.init(rng, img, ctx, train=False)
     out = tok.apply(variables, img, ctx, train=False)
     assert out.shape == (1, 1, 2 * 3, 64)  # h'·w' spatial tokens (reference :80-85)
+
+
+@pytest.mark.slow
+def test_efficientnet_remat_grad_parity():
+    """remat=True on the conv trunk (MBConv blocks under jax.checkpoint,
+    stochastic depth + FiLM interleaved) must reproduce the stored-
+    activation path's loss and gradients."""
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    models = [
+        EfficientNet(
+            width_coefficient=0.35, depth_coefficient=0.35,
+            include_top=False, include_film=True, remat=r,
+        )
+        for r in (False, True)
+    ]
+    ctx = jax.random.normal(jax.random.PRNGKey(2), (2, 512))
+    variables = models[0].init(
+        jax.random.PRNGKey(1), x, context=ctx, train=False
+    )
+    results = []
+    for m in models:
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.sum(m.apply(p, x, context=ctx, train=False) ** 2)
+        )(variables)
+        results.append((float(loss), grads))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        results[0][1],
+        results[1][1],
+    )
