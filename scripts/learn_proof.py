@@ -230,7 +230,7 @@ class RandomPolicy:
         return self._rng.uniform(self._low, self._high, 2).astype("float32")
 
 
-def _run_protocol(policy, tag):
+def _run_protocol(policy, tag, write_videos=False):
     from rt1_tpu.envs import blocks
     from rt1_tpu.eval.evaluate import evaluate_policy
 
@@ -242,6 +242,7 @@ def _run_protocol(policy, tag):
         block_mode=blocks.BlockMode(FLAGS.block_mode),
         seed=EVAL_SEED,
         embedder=FLAGS.embedder,
+        write_videos=write_videos,
         env_kwargs=dict(
             target_height=FLAGS.height, target_width=FLAGS.width,
             sequence_length=FLAGS.seq_len
@@ -251,6 +252,24 @@ def _run_protocol(policy, tag):
     print(f"{tag}: {successes}/{FLAGS.eval_episodes} successes "
           f"(mean len {results['mean_episode_length'][REWARD]:.1f})")
     return results
+
+
+def _copy_proof_videos(video_dir, max_videos=3):
+    """Stage a few trained-policy episode videos into the repo's artifacts
+    (successes preferred) so the proof material survives the workdir."""
+    import glob
+    import shutil
+
+    if not os.path.isdir(video_dir):
+        return
+    vids = sorted(glob.glob(os.path.join(video_dir, "*success*"))) + sorted(
+        glob.glob(os.path.join(video_dir, "*failure*"))
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    dest = os.path.join(repo, "artifacts", "learn_proof_videos")
+    os.makedirs(dest, exist_ok=True)
+    for src in vids[:max_videos]:
+        shutil.copy2(src, dest)
 
 
 def _read_curves(train_dir):
@@ -296,8 +315,9 @@ def stage_eval(train_dir, data_dir):
     _check_train_meta(train_dir, "eval", EVAL_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="eval")
     policy = _restore_policy(train_dir, data_dir)
-    trained = _run_protocol(policy, "trained")
+    trained = _run_protocol(policy, "trained", write_videos=True)
     random_results = _run_protocol(RandomPolicy(seed=EVAL_SEED), "random")
+    _copy_proof_videos(os.path.join(FLAGS.workdir, "eval", "trained", "videos"))
 
     curves = _read_curves(train_dir)
     _plot_curves(curves, os.path.join(FLAGS.workdir, "loss_curve.png"))
@@ -326,6 +346,22 @@ def stage_eval(train_dir, data_dir):
     with open(os.path.join(FLAGS.workdir, "learn_proof.json"), "w") as f:
         json.dump(summary, f, indent=2)
     print(json.dumps(summary, indent=2))
+
+    # Self-archive into the repo so an unattended run leaves committed-able
+    # proof even if nobody touches the workdir afterwards.
+    import shutil
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tag = os.path.basename(os.path.normpath(FLAGS.workdir))
+    art = os.path.join(repo, "artifacts")
+    os.makedirs(art, exist_ok=True)
+    shutil.copy2(
+        os.path.join(FLAGS.workdir, "learn_proof.json"),
+        os.path.join(art, f"{tag}_r03.json"),
+    )
+    curve = os.path.join(FLAGS.workdir, "loss_curve.png")
+    if os.path.exists(curve):
+        shutil.copy2(curve, os.path.join(art, f"{tag}_loss_curve_r03.png"))
     return summary
 
 
