@@ -62,6 +62,10 @@ flags.DEFINE_enum(
     "dtype", "bfloat16", ["bfloat16", "float32"],
     "Model compute dtype. bfloat16 on TPU; float32 is ~1.4x faster on the "
     "CPU fallback (oneDNN emulates bf16).")
+flags.DEFINE_string(
+    "run_tag", "r03",
+    "Label stamped into the self-archived artifact filenames; pass a fresh "
+    "tag per round/run so reruns don't clobber earlier proof records.")
 
 REWARD = "block2block"
 EVAL_SEED = 10_000  # disjoint from collection worker seeds (0..workers)
@@ -254,22 +258,39 @@ def _run_protocol(policy, tag, write_videos=False):
     return results
 
 
-def _copy_proof_videos(video_dir, max_videos=3):
-    """Stage a few trained-policy episode videos into the repo's artifacts
-    (successes preferred) so the proof material survives the workdir."""
-    import glob
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACTS_DIR = os.path.join(REPO_ROOT, "artifacts")
+
+
+def _archive(src, dest_name):
+    """Copy one proof file into the repo's artifacts/ (committable)."""
     import shutil
+
+    if not os.path.exists(src):
+        return
+    os.makedirs(os.path.dirname(os.path.join(ARTIFACTS_DIR, dest_name)),
+                exist_ok=True)
+    shutil.copy2(src, os.path.join(ARTIFACTS_DIR, dest_name))
+
+
+def _copy_proof_videos(video_dir, prefix, max_videos=3):
+    """Stage a few trained-policy episode videos into the repo's artifacts
+    (successes preferred). Filenames are prefixed with the workdir tag and
+    --run_tag so reruns/rounds never clobber earlier proof records."""
+    import glob
 
     if not os.path.isdir(video_dir):
         return
     vids = sorted(glob.glob(os.path.join(video_dir, "*success*"))) + sorted(
         glob.glob(os.path.join(video_dir, "*failure*"))
     )
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    dest = os.path.join(repo, "artifacts", "learn_proof_videos")
-    os.makedirs(dest, exist_ok=True)
     for src in vids[:max_videos]:
-        shutil.copy2(src, dest)
+        _archive(
+            src,
+            os.path.join(
+                "learn_proof_videos", f"{prefix}_{os.path.basename(src)}"
+            ),
+        )
 
 
 def _read_curves(train_dir):
@@ -314,10 +335,20 @@ def stage_eval(train_dir, data_dir):
 
     _check_train_meta(train_dir, "eval", EVAL_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="eval")
+    # Clear stale videos from earlier evals of this workdir: filenames carry
+    # the success/failure tag, so a rerun would otherwise leave a mixture
+    # and the success-preferring archive below could stage an outcome the
+    # current checkpoint did not achieve.
+    import shutil
+
+    video_dir = os.path.join(FLAGS.workdir, "eval", "trained", "videos")
+    shutil.rmtree(video_dir, ignore_errors=True)
+
     policy = _restore_policy(train_dir, data_dir)
     trained = _run_protocol(policy, "trained", write_videos=True)
     random_results = _run_protocol(RandomPolicy(seed=EVAL_SEED), "random")
-    _copy_proof_videos(os.path.join(FLAGS.workdir, "eval", "trained", "videos"))
+    tag = os.path.basename(os.path.normpath(FLAGS.workdir))
+    _copy_proof_videos(video_dir, prefix=f"{tag}_{FLAGS.run_tag}")
 
     curves = _read_curves(train_dir)
     _plot_curves(curves, os.path.join(FLAGS.workdir, "loss_curve.png"))
@@ -349,19 +380,14 @@ def stage_eval(train_dir, data_dir):
 
     # Self-archive into the repo so an unattended run leaves committed-able
     # proof even if nobody touches the workdir afterwards.
-    import shutil
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    tag = os.path.basename(os.path.normpath(FLAGS.workdir))
-    art = os.path.join(repo, "artifacts")
-    os.makedirs(art, exist_ok=True)
-    shutil.copy2(
+    _archive(
         os.path.join(FLAGS.workdir, "learn_proof.json"),
-        os.path.join(art, f"{tag}_r03.json"),
+        f"{tag}_{FLAGS.run_tag}.json",
     )
-    curve = os.path.join(FLAGS.workdir, "loss_curve.png")
-    if os.path.exists(curve):
-        shutil.copy2(curve, os.path.join(art, f"{tag}_loss_curve_r03.png"))
+    _archive(
+        os.path.join(FLAGS.workdir, "loss_curve.png"),
+        f"{tag}_loss_curve_{FLAGS.run_tag}.png",
+    )
     return summary
 
 
