@@ -263,14 +263,24 @@ ARTIFACTS_DIR = os.path.join(REPO_ROOT, "artifacts")
 
 
 def _archive(src, dest_name):
-    """Copy one proof file into the repo's artifacts/ (committable)."""
+    """Copy one proof file into the repo's artifacts/ (committable).
+
+    Never overwrites: an existing destination gets a uniquified sibling
+    (`name-1.ext`, `name-2.ext`, ...) so a rerun with the same --run_tag
+    cannot clobber an earlier round's committed proof record.
+    """
     import shutil
 
     if not os.path.exists(src):
         return
-    os.makedirs(os.path.dirname(os.path.join(ARTIFACTS_DIR, dest_name)),
-                exist_ok=True)
-    shutil.copy2(src, os.path.join(ARTIFACTS_DIR, dest_name))
+    dest = os.path.join(ARTIFACTS_DIR, dest_name)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    stem, ext = os.path.splitext(dest)
+    n = 1
+    while os.path.exists(dest):
+        dest = f"{stem}-{n}{ext}"
+        n += 1
+    shutil.copy2(src, dest)
 
 
 def _copy_proof_videos(video_dir, prefix, max_videos=3):
