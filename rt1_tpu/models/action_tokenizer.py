@@ -92,3 +92,64 @@ def detokenize(
         else:
             raise ValueError(f"unsupported spec {spec!r}")
     return action
+
+
+def box_bin_values(
+    action_space: Mapping[str, Spec], vocab_size: int
+):
+    """Per-token bin centers + Box mask for soft-argmax regression.
+
+    Returns `(values, mask)` with shapes `(tokens_per_action, vocab_size)`
+    and `(tokens_per_action,)`: `values[k, v]` is the continuous action the
+    detokenizer maps token `v` to for Box token `k` (`detokenize`'s
+    `v / (V-1) * (high-low) + low`), rows for Discrete tokens are zero and
+    masked out. With these, `E[a_k] = sum_v softmax(logits_k)[v] *
+    values[k, v]` is the differentiable expectation of the detokenized
+    action — the soft-argmax used by the auxiliary MSE loss
+    (`RT1Policy.aux_mse_weight`)."""
+    import numpy as np
+
+    if vocab_size < 2:
+        raise ValueError(f"vocab_size must be >= 2, got {vocab_size}")
+    if not any(isinstance(s, BoxSpec) for s in action_space.values()):
+        raise ValueError(
+            "soft-argmax regression needs at least one Box action entry; "
+            "this action space is all-Discrete"
+        )
+    rows = []
+    mask = []
+    grid = np.arange(vocab_size, dtype=np.float32) / float(vocab_size - 1)
+    for key, spec in action_space.items():
+        if isinstance(spec, DiscreteSpec):
+            rows.append(np.zeros((1, vocab_size), np.float32))
+            mask.append(np.zeros((1,), np.float32))
+        elif isinstance(spec, BoxSpec):
+            low = np.asarray(spec.low_array(), np.float32)
+            high = np.asarray(spec.high_array(), np.float32)
+            rows.append(grid[None, :] * (high - low)[:, None] + low[:, None])
+            mask.append(np.ones((spec.shape[0],), np.float32))
+        else:
+            raise ValueError(f"unsupported spec {spec!r}")
+    return np.concatenate(rows, 0), np.concatenate(mask, 0)
+
+
+def continuous_targets(
+    action_space: Mapping[str, Spec], action: Dict[str, jnp.ndarray]
+) -> jnp.ndarray:
+    """Clipped continuous action values laid out per token (..., A).
+
+    Discrete slots carry zeros (masked by `box_bin_values`' mask); Box slots
+    carry the clipped raw values — the regression targets matching the
+    tokenizer's clipping (`tokenize`)."""
+    parts = []
+    for key, spec in action_space.items():
+        a = jnp.asarray(action[key])
+        if isinstance(spec, DiscreteSpec):
+            parts.append(jnp.zeros(a.shape + (1,), jnp.float32))
+        elif isinstance(spec, BoxSpec):
+            low = jnp.asarray(spec.low_array())
+            high = jnp.asarray(spec.high_array())
+            parts.append(jnp.clip(a, low, high).astype(jnp.float32))
+        else:
+            raise ValueError(f"unsupported spec {spec!r}")
+    return jnp.concatenate(parts, axis=-1)

@@ -31,6 +31,9 @@ def get_config():
     # Focal CE modulation (models/rt1.py): 0 = reference parity; > 0 fights
     # the BC marginal-collapse ("copycat") failure on smooth oracle demos.
     config.model.focal_gamma = 0.0
+    # Soft-argmax MSE auxiliary (models/rt1.py): dense regression gradient
+    # that bypasses the token-CE marginal plateau. 0 = reference parity.
+    config.model.aux_mse_weight = 0.0
     # jax.checkpoint the transformer + MBConv blocks: ~1/3 extra FLOPs for
     # O(1) activation memory — turn on when HBM, not compute, caps batch.
     config.model.remat = False

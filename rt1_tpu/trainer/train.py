@@ -149,15 +149,17 @@ def make_train_step_fns(
             extra = float(accum_steps) if ref_scale else 1.0
 
             def micro(carry, xs):
-                grads_acc, loss_acc, aux_acc, bs = carry
+                grads_acc, loss_acc, aux_acc, mse_acc, bs = carry
                 mb, r = xs
                 (l, (mb_out, bs)), g = grad_fn(state.params, bs, mb, r)
-                # Metric only: the aux term's gradient already flows via l.
+                # Metric only: the aux terms' gradients already flow via l.
                 aux_acc = aux_acc + mb_out.get("moe_aux_loss", jnp.zeros(()))
+                mse_acc = mse_acc + mb_out.get("aux_mse", jnp.zeros(()))
                 return (
                     jax.tree.map(jnp.add, grads_acc, g),
                     loss_acc + l,
                     aux_acc,
+                    mse_acc,
                     bs,
                 ), None
 
@@ -167,9 +169,10 @@ def make_train_step_fns(
             micro_batches = jax.tree.map(split, batch)
             rngs = jax.random.split(rng, accum_steps)
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            (grads, loss, aux, new_bs), _ = jax.lax.scan(
+            (grads, loss, aux, mse, new_bs), _ = jax.lax.scan(
                 micro,
-                (zero_grads, jnp.zeros(()), jnp.zeros(()), state.batch_stats),
+                (zero_grads, jnp.zeros(()), jnp.zeros(()), jnp.zeros(()),
+                 state.batch_stats),
                 (micro_batches, rngs),
             )
             grads = jax.tree.map(lambda g: g / (accum_steps * extra), grads)
@@ -177,6 +180,8 @@ def make_train_step_fns(
             out = {"loss": loss}
             if getattr(model, "ffn_impl", "dense") == "moe":
                 out["moe_aux_loss"] = aux / accum_steps  # mean over micros
+            if getattr(model, "aux_mse_weight", 0.0) > 0:
+                out["aux_mse"] = mse / accum_steps  # mean over micros
 
         new_state = state.apply_gradients(grads, new_batch_stats=new_bs)
         metrics = {
@@ -187,6 +192,8 @@ def make_train_step_fns(
             metrics["action_loss_mean"] = jnp.mean(out["action_loss"])
         if "moe_aux_loss" in out:  # routing-collapse monitor
             metrics["moe_aux_loss"] = out["moe_aux_loss"]
+        if "aux_mse" in out:  # soft-argmax regression monitor
+            metrics["aux_mse"] = out["aux_mse"]
         return new_state, metrics
 
     def eval_step(state: TrainState, batch: Batch):

@@ -58,6 +58,10 @@ flags.DEFINE_integer(
 flags.DEFINE_float(
     "focal_gamma", 0.0,
     "Focal CE modulation (models/rt1.py); 0 = reference parity.")
+flags.DEFINE_float(
+    "aux_mse_weight", 0.0,
+    "Soft-argmax MSE auxiliary weight (models/rt1.py); bypasses the token-"
+    "CE marginal plateau. 0 = reference parity.")
 flags.DEFINE_enum(
     "dtype", "bfloat16", ["bfloat16", "float32"],
     "Model compute dtype. bfloat16 on TPU; float32 is ~1.4x faster on the "
@@ -78,6 +82,7 @@ def get_train_config(data_dir, num_steps):
     config.model.image_tokenizer = FLAGS.image_tokenizer
     config.model.time_sequence_length = FLAGS.seq_len
     config.model.focal_gamma = FLAGS.focal_gamma
+    config.model.aux_mse_weight = FLAGS.aux_mse_weight
     config.model.dtype = FLAGS.dtype
     config.data.data_dir = data_dir
     config.data.height = FLAGS.height
@@ -124,7 +129,7 @@ def stage_collect():
 # success rates attributed to the wrong config.
 EVAL_META_KEYS = (
     "seq_len", "image_tokenizer", "height", "width", "dtype", "focal_gamma",
-    "embedder",
+    "aux_mse_weight", "embedder",
 )
 # batch additionally matters when *resuming training* (optimizer/data order),
 # but params are batch-independent, so eval may legitimately differ.
@@ -371,6 +376,7 @@ def stage_eval(train_dir, data_dir):
         "train_steps": FLAGS.num_steps,
         "seq_len": FLAGS.seq_len,
         "focal_gamma": FLAGS.focal_gamma,
+        "aux_mse_weight": FLAGS.aux_mse_weight,
         "image_tokenizer": FLAGS.image_tokenizer,
         "resolution": [FLAGS.height, FLAGS.width],
         "eval_episodes": FLAGS.eval_episodes,

@@ -134,6 +134,45 @@ def test_focal_gamma(policy_and_params, rng):
     assert any(float(np.max(np.abs(np.asarray(g)))) > 0 for g in flat)
 
 
+def test_aux_mse_soft_argmax(policy_and_params, rng):
+    """aux_mse_weight adds a parameter-free soft-argmax regression term:
+    E[a] under the token softmax vs the clipped continuous label. Bin math
+    must agree with the detokenizer, the aux must appear in the output, and
+    gradients must flow."""
+    from rt1_tpu.models import action_tokenizer
+    from rt1_tpu.specs import language_table_action_space
+
+    space = language_table_action_space()
+    bins, mask = action_tokenizer.box_bin_values(space, VOCAB)
+    assert bins.shape == (A_TOK, VOCAB) and mask.tolist() == [0.0, 1.0, 1.0]
+    # A one-hot distribution's expectation == the detokenized bin value.
+    tok = jnp.full((1, A_TOK), 7, jnp.int32)
+    det = action_tokenizer.detokenize(space, tok, VOCAB)["action"]
+    np.testing.assert_allclose(np.asarray(bins[1:, 7]), np.asarray(det[0]), rtol=1e-6)
+
+    model, params = policy_and_params
+    obs, actions = make_batch(rng, b=2)
+    out0 = model.apply(params, obs, actions, train=False)
+    model_a = tiny_policy(aux_mse_weight=10.0)
+    out_a = model_a.apply(params, obs, actions, train=False)
+    assert "aux_mse" in out_a and float(out_a["aux_mse"]) > 0
+    # Under 'reference' scaling the aux term shares the CE normalizer, so
+    # accumulation exactness and CE/aux balance are batch-independent.
+    num_items = 2 * T * (I_TOK + A_TOK)
+    np.testing.assert_allclose(
+        float(out_a["loss"]),
+        float(out0["loss"]) + 10.0 * float(out_a["aux_mse"]) / num_items,
+        rtol=1e-5,
+    )
+    grads = jax.grad(
+        lambda p: model_a.apply(p, obs, actions, train=False)["loss"]
+    )(params)
+    assert all(
+        np.all(np.isfinite(np.asarray(g)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
 def test_remat_preserves_loss_and_grads(policy_and_params, rng):
     """remat=True is a memory/compute trade, NOT a semantic change: loss and
     gradients must match the stored-activation path. (The tiny tokenizer has

@@ -156,6 +156,38 @@ def test_grad_accumulation_matches_full_batch():
     )
 
 
+def test_grad_accumulation_exact_with_aux_mse():
+    """Accumulation exactness must survive aux_mse_weight > 0: the aux term
+    shares the reference CE normalizer (∝ 1/(b·t·(I+A))), so the trainer's
+    /accum correction applies to the whole loss, and the aux_mse metric is
+    reported from the accumulated path too."""
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    model = tiny_policy(crop_ratio=0.0, aux_mse_weight=5.0)
+    rng = jax.random.PRNGKey(0)
+    obs, actions = make_batch(rng, b=8)
+    tx = make_optimizer()
+    state = create_train_state(model, rng, (obs, actions), tx)
+
+    fns1 = make_train_step_fns(model, mesh, state, accum_steps=1, donate=False)
+    fns4 = make_train_step_fns(model, mesh, state, accum_steps=4, donate=False)
+    b = fns1.shard_batch((obs, actions))
+    ns1, m1 = fns1.train_step(fns1.shard_state(state), b, jax.random.PRNGKey(5))
+    ns4, m4 = fns4.train_step(fns4.shard_state(state), b, jax.random.PRNGKey(5))
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]), rtol=1e-5)
+    assert "aux_mse" in m1 and "aux_mse" in m4
+    np.testing.assert_allclose(
+        float(m1["aux_mse"]), float(m4["aux_mse"]), rtol=1e-4
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        ),
+        ns1.params,
+        ns4.params,
+    )
+
+
 def test_eval_step_metrics():
     mesh = make_mesh(MeshConfig())
     model, fns, state, batch = _setup(mesh)
