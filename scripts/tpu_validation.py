@@ -112,12 +112,14 @@ def ring_forward_on_chip():
     return {"max_abs_err_vs_dense": err, "ok": err < 1e-4}
 
 
-def wait_for_chip(max_probes=20, probe_timeout=120, sleep_s=180):
+def wait_for_chip(max_probes=8, probe_timeout=2100, sleep_s=120):
     """Block until the axon chip is claimable (probe in a subprocess).
 
-    A SIGKILLed client leaves the grant held server-side; probing with a
-    subprocess (which exits cleanly, releasing its own claim) tells us when
-    the stale lease has expired without wedging this process.
+    The probe timeout must EXCEED the wedge's own client-side give-up time
+    (~25 min hang, then rc=1 UNAVAILABLE): a wedged claim that we kill on a
+    short timeout dies mid-claim and RE-EXTENDS the wedge (observed
+    2026-07-30 — each timeout-killed prober adds another lease cycle). With
+    a 35-min budget the probe always exits on its own, killing nothing.
     """
     import time as _time
 
@@ -125,14 +127,18 @@ def wait_for_chip(max_probes=20, probe_timeout=120, sleep_s=180):
         try:
             probe = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout + 60,
+                timeout=probe_timeout,
                 capture_output=True,
                 cwd=REPO,
             )
             if probe.returncode == 0:
                 return True
         except subprocess.TimeoutExpired:
-            pass  # hung in the bind loop == lease still held
+            # Should not happen with the 35-min budget; if it does, stop
+            # probing entirely rather than keep feeding the wedge.
+            print("chip probe exceeded even the wedge give-up time; "
+                  "stopping probes", flush=True)
+            return False
         print(f"chip probe {i + 1}: not claimable yet", flush=True)
         _time.sleep(sleep_s)
     return False
