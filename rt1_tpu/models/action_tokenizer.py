@@ -153,3 +153,42 @@ def continuous_targets(
         else:
             raise ValueError(f"unsupported spec {spec!r}")
     return jnp.concatenate(parts, axis=-1)
+
+
+def detokenize_expected(
+    action_space: Mapping[str, Spec],
+    logits: jnp.ndarray,
+    vocab_size: int,
+) -> Dict[str, jnp.ndarray]:
+    """Soft decode: Box entries are E[a] under the token softmax.
+
+    `logits`: (..., tokens_per_action, vocab_size). Discrete entries decode
+    by argmax (a probability-weighted mean of category ids is meaningless);
+    Box entries return `sum_v p_v * detokenize(v)` — smoother than argmax
+    for CE-trained policies whose distribution mass straddles a bin edge,
+    and consistent with the `aux_mse_weight` training objective.
+    """
+    import jax
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # Single source of truth for the token→value mapping: the same bin
+    # table the aux-MSE training objective uses (box_bin_values), so the
+    # train-time expectation and this decode can never drift apart.
+    values, _ = box_bin_values(action_space, vocab_size)
+    values = jnp.asarray(values)                        # (A, V)
+    expected = jnp.einsum("...av,av->...a", probs, values)
+    action: Dict[str, jnp.ndarray] = {}
+    idx = 0
+    for key, spec in action_space.items():
+        if isinstance(spec, DiscreteSpec):
+            tok = jnp.argmax(logits[..., idx, :], axis=-1).astype(jnp.int32)
+            # Reference OOV quirk, as in `detokenize`.
+            action[key] = jnp.where(tok > spec.n, jnp.zeros_like(tok), tok)
+            idx += 1
+        elif isinstance(spec, BoxSpec):
+            dim = spec.shape[0]
+            action[key] = expected[..., idx : idx + dim]
+            idx += dim
+        else:
+            raise ValueError(f"unsupported spec {spec!r}")
+    return action

@@ -115,6 +115,13 @@ class RT1Policy(nn.Module):
     # 2.508 nats on the oracle corpus) with ~zero input-dependence.
     # 0 disables (reference parity).
     aux_mse_weight: float = 0.0
+    # Inference action decode: "argmax" (reference parity,
+    # transformer_network.py:262) or "expected" — E[a] under the token
+    # softmax for Box dims (action_tokenizer.detokenize_expected), smoother
+    # when distribution mass straddles a bin edge and consistent with the
+    # aux_mse training objective. The rolling state always stores argmax
+    # tokens either way (the reference's state semantics).
+    action_decode: str = "argmax"
     return_attention_scores: bool = False
     dtype: jnp.dtype = jnp.float32
     # "dense" (default), "ring", or "pallas". "ring" shards the token
@@ -172,6 +179,11 @@ class RT1Policy(nn.Module):
         return self.time_sequence_length * self.single_step_tokens
 
     def setup(self):
+        if self.action_decode not in ("argmax", "expected"):
+            raise ValueError(
+                f"action_decode must be 'argmax' or 'expected', got "
+                f"{self.action_decode!r}"
+            )
         if self.image_tokenizer_def is not None:
             self.image_tokenizer = self.image_tokenizer_def
         else:
@@ -449,7 +461,18 @@ class RT1Policy(nn.Module):
             "seq_idx": jnp.minimum(seq_idx + 1, self.time_sequence_length),
         }
         output = {"action_tokens": tokens, "action_logits": step_logits}
-        output.update(action_tokenizer.detokenize(self.action_space, tokens, self.vocab_size))
+        if self.action_decode == "expected":
+            output.update(
+                action_tokenizer.detokenize_expected(
+                    self.action_space, step_logits, self.vocab_size
+                )
+            )
+        else:
+            output.update(
+                action_tokenizer.detokenize(
+                    self.action_space, tokens, self.vocab_size
+                )
+            )
         return output, new_state
 
     def infer_step_autoregressive(
@@ -485,7 +508,18 @@ class RT1Policy(nn.Module):
             "seq_idx": jnp.minimum(seq_idx + 1, self.time_sequence_length),
         }
         output = {"action_tokens": tokens, "action_logits": step_logits}
-        output.update(action_tokenizer.detokenize(self.action_space, tokens, self.vocab_size))
+        if self.action_decode == "expected":
+            output.update(
+                action_tokenizer.detokenize_expected(
+                    self.action_space, step_logits, self.vocab_size
+                )
+            )
+        else:
+            output.update(
+                action_tokenizer.detokenize(
+                    self.action_space, tokens, self.vocab_size
+                )
+            )
         return output, new_state
 
 

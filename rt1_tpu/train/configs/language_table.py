@@ -34,6 +34,8 @@ def get_config():
     # Soft-argmax MSE auxiliary (models/rt1.py): dense regression gradient
     # that bypasses the token-CE marginal plateau. 0 = reference parity.
     config.model.aux_mse_weight = 0.0
+    # Inference decode: "argmax" (reference parity) | "expected" (soft E[a]).
+    config.model.action_decode = "argmax"
     # jax.checkpoint the transformer + MBConv blocks: ~1/3 extra FLOPs for
     # O(1) activation memory — turn on when HBM, not compute, caps batch.
     config.model.remat = False

@@ -89,6 +89,7 @@ def build_model(model_config, mesh=None):
         ),
         focal_gamma=model_config.get("focal_gamma", 0.0),
         aux_mse_weight=model_config.get("aux_mse_weight", 0.0),
+        action_decode=model_config.get("action_decode", "argmax"),
         remat=model_config.get("remat", False),
         attention_impl=model_config.get("attention_impl", "dense"),
         mesh=mesh,

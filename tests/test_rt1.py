@@ -173,6 +173,52 @@ def test_aux_mse_soft_argmax(policy_and_params, rng):
     )
 
 
+def test_expected_action_decode(policy_and_params, rng):
+    """action_decode='expected' emits E[a] for Box dims: bounded by the
+    action space, equal to argmax-decode in the sharp-logit limit, and
+    identical Discrete handling; state semantics unchanged."""
+    from rt1_tpu.models import action_tokenizer
+    from rt1_tpu.specs import language_table_action_space
+
+    space = language_table_action_space()
+    # Sharp logits -> expected == detokenize(argmax).
+    sharp = np.full((1, A_TOK, VOCAB), -30.0, np.float32)
+    for k, tok in enumerate((1, 5, 9)):
+        sharp[0, k, tok] = 30.0
+    exp = action_tokenizer.detokenize_expected(space, jnp.asarray(sharp), VOCAB)
+    hard = action_tokenizer.detokenize(
+        space, jnp.asarray([[1, 5, 9]], jnp.int32), VOCAB
+    )
+    np.testing.assert_allclose(
+        np.asarray(exp["action"]), np.asarray(hard["action"]), atol=1e-4
+    )
+    assert int(exp["terminate_episode"][0]) == int(hard["terminate_episode"][0])
+    # OOV Discrete (tok > n, the reference quirk) decodes to 0 here too.
+    oov = np.full((1, A_TOK, VOCAB), -30.0, np.float32)
+    for k, tok in enumerate((5, 5, 9)):  # Discrete(2) slot gets tok 5 > n
+        oov[0, k, tok] = 30.0
+    exp_oov = action_tokenizer.detokenize_expected(space, jnp.asarray(oov), VOCAB)
+    assert int(exp_oov["terminate_episode"][0]) == 0
+
+    model, params = policy_and_params
+    model_e = tiny_policy(action_decode="expected")
+    state = model_e.initial_state(batch_size=1)
+    frame = {
+        "image": jax.random.uniform(rng, (1, H, W, 3)),
+        "natural_language_embedding": jax.random.normal(rng, (1, 8)),
+    }
+    out_e, state_e = model_e.apply(params, frame, state, method=model_e.infer_step)
+    out_h, state_h = model.apply(
+        params, frame, model.initial_state(batch_size=1), method=model.infer_step
+    )
+    # E[a] stays inside the Box bounds and the rolling state (argmax tokens)
+    # is identical between decode modes.
+    assert np.all(np.abs(np.asarray(out_e["action"])) <= 0.1 + 1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(state_e["action_tokens"]), np.asarray(state_h["action_tokens"])
+    )
+
+
 def test_remat_preserves_loss_and_grads(policy_and_params, rng):
     """remat=True is a memory/compute trade, NOT a semantic change: loss and
     gradients must match the stored-activation path. (The tiny tokenizer has
