@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-3 flagship pipeline: wait for the oracle corpus -> long-regime
+# flagship training on the attached TPU chip -> closed-loop eval (trained +
+# random baseline). Committed in-repo because the host is reset between
+# round sessions (round-3 lesson: /root/tpu_round3.sh and the collected
+# corpus at /root/learn_proof both vanished with the reset).
+#
+# Resumable at every stage: collection writes a manifest, training resumes
+# from the latest Orbax checkpoint, eval restores the latest checkpoint.
+# Chip-wedge-patient: a failed train invocation (axon UNAVAILABLE) is
+# retried after a cooldown instead of aborting the pipeline; SIGKILL is
+# never used (a killed claim wedges the chip server-side — round-2 lesson).
+#
+# Usage: setsid nohup bash scripts/round3_pipeline.sh > artifacts/pipeline_r03.log 2>&1 &
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+WORKDIR="${WORKDIR:-/root/learn_proof}"
+STEPS="${STEPS:-60000}"
+TAG="${TAG:-r03}"
+cd "$REPO"
+
+log() { echo "[pipeline $(date +%H:%M:%S)] $*"; }
+
+# ---- stage 0: wait for the corpus (collection runs in its own process) ----
+while [ ! -f "$WORKDIR/data/manifest.json" ]; do
+  log "waiting for collection manifest..."
+  sleep 60
+done
+log "corpus ready: $(cat "$WORKDIR/data/manifest.json" | tr -d '\n')"
+
+# ---- stage 1: long-regime flagship training (patient on chip wedges) ----
+train_ok=0
+for attempt in $(seq 1 24); do
+  log "train attempt $attempt (target $STEPS steps)"
+  if python scripts/learn_proof.py --workdir "$WORKDIR" --stage train \
+    --num_steps "$STEPS" --run_tag "$TAG"; then train_ok=1; break; fi
+  rc=$?
+  log "train attempt $attempt exited rc=$rc; cooldown 300s"
+  sleep 300
+done
+
+LATEST=$(ls "$WORKDIR/train/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
+if [ "$train_ok" = 1 ]; then
+  log "training done (latest checkpoint: ${LATEST:-none})"
+else
+  log "TRAINING DID NOT REACH $STEPS (latest checkpoint: ${LATEST:-none}) — retries exhausted"
+fi
+[ -z "${LATEST:-}" ] && { log "no checkpoint produced; aborting"; exit 1; }
+# A partial run still gets evaluated (any 2500-step checkpoint is a valid
+# measurement point), but the log above flags it as undertrained.
+
+# ---- stage 2: closed-loop eval, trained + random baseline ----
+eval_ok=0
+for attempt in $(seq 1 12); do
+  log "eval attempt $attempt"
+  if python scripts/learn_proof.py --workdir "$WORKDIR" --stage eval \
+    --num_steps "$STEPS" --run_tag "$TAG"; then eval_ok=1; break; fi
+  rc=$?
+  log "eval attempt $attempt exited rc=$rc; cooldown 300s"
+  sleep 300
+done
+if [ "$eval_ok" = 1 ]; then
+  log "pipeline complete (trained to step ${LATEST}); artifacts under $WORKDIR and repo artifacts/"
+else
+  log "EVAL FAILED after all retries; no learn_proof.json produced"
+  exit 1
+fi

@@ -157,12 +157,25 @@ def main():
     from rt1_tpu.compilation_cache import enable_persistent_cache
 
     enable_persistent_cache()
-    results = {}
+    # `status` rides inside results through every checkpoint (flipped to
+    # "done" at the end), so an in-progress file is always distinguishable
+    # from a completed one — not just before the first checkpoint.
+    results = {"status": "running"}
     out_path = os.path.join(REPO, args.out)
 
     def checkpoint_results():
-        with open(out_path, "w") as f:
+        # tmp + rename: a poller never sees a truncated/partial JSON file.
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=2)
+        os.replace(tmp, out_path)
+
+    # Overwrite any stale result file immediately: a previous run's
+    # (possibly committed) output at the same path otherwise reads as THIS
+    # run's state until the first checkpoint lands — observed round 3:
+    # yesterday's wedge error was mistaken for a live failure and a healthy
+    # run was killed.
+    checkpoint_results()
 
     if not args.skip_bench:
         def chip_related(headline):
@@ -212,6 +225,7 @@ def main():
         results["ring_on_chip"] = f"FAILED: {e!r}"[:500]
     print("ring ->", results["ring_on_chip"], flush=True)
 
+    results["status"] = "done"
     checkpoint_results()
     print(json.dumps(results, indent=2))
 
