@@ -184,6 +184,18 @@ class RT1Policy(nn.Module):
                 f"action_decode must be 'argmax' or 'expected', got "
                 f"{self.action_decode!r}"
             )
+        if self.action_decode == "expected" and not any(
+            isinstance(s, action_tokenizer.BoxSpec)
+            for s in self.action_space.values()
+        ):
+            # box_bin_values (the E[a] bin table) would raise at trace time
+            # with a message about the aux-MSE objective; fail at
+            # construction with the real reason instead.
+            raise ValueError(
+                "action_decode='expected' needs at least one Box action "
+                "entry (soft decode only differs from argmax for Box); "
+                "this action space is all-Discrete — use 'argmax'"
+            )
         if self.image_tokenizer_def is not None:
             self.image_tokenizer = self.image_tokenizer_def
         else:
@@ -461,19 +473,19 @@ class RT1Policy(nn.Module):
             "seq_idx": jnp.minimum(seq_idx + 1, self.time_sequence_length),
         }
         output = {"action_tokens": tokens, "action_logits": step_logits}
-        if self.action_decode == "expected":
-            output.update(
-                action_tokenizer.detokenize_expected(
-                    self.action_space, step_logits, self.vocab_size
-                )
-            )
-        else:
-            output.update(
-                action_tokenizer.detokenize(
-                    self.action_space, tokens, self.vocab_size
-                )
-            )
+        output.update(self._decode_action(tokens, step_logits))
         return output, new_state
+
+    def _decode_action(self, tokens, step_logits):
+        """Token→action decode shared by both inference paths
+        (`action_decode`: hard argmax detokenize vs soft E[a])."""
+        if self.action_decode == "expected":
+            return action_tokenizer.detokenize_expected(
+                self.action_space, step_logits, self.vocab_size
+            )
+        return action_tokenizer.detokenize(
+            self.action_space, tokens, self.vocab_size
+        )
 
     def infer_step_autoregressive(
         self, observation: Dict[str, jnp.ndarray], state: Dict[str, jnp.ndarray]
@@ -508,18 +520,7 @@ class RT1Policy(nn.Module):
             "seq_idx": jnp.minimum(seq_idx + 1, self.time_sequence_length),
         }
         output = {"action_tokens": tokens, "action_logits": step_logits}
-        if self.action_decode == "expected":
-            output.update(
-                action_tokenizer.detokenize_expected(
-                    self.action_space, step_logits, self.vocab_size
-                )
-            )
-        else:
-            output.update(
-                action_tokenizer.detokenize(
-                    self.action_space, tokens, self.vocab_size
-                )
-            )
+        output.update(self._decode_action(tokens, step_logits))
         return output, new_state
 
 

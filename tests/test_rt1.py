@@ -219,6 +219,27 @@ def test_expected_action_decode(policy_and_params, rng):
     )
 
 
+def test_expected_decode_rejects_all_discrete(rng):
+    """'expected' with an all-Discrete action space is rejected at setup
+    with the real reason (soft decode only differs from argmax for Box) —
+    not at trace time by box_bin_values with an aux-MSE-flavored message."""
+    from rt1_tpu.specs import DiscreteSpec
+
+    model = tiny_policy(
+        action_space={"terminate_episode": DiscreteSpec(2)},
+        action_decode="expected",
+    )
+    frame = {
+        "image": jax.random.uniform(rng, (1, H, W, 3)),
+        "natural_language_embedding": jax.random.normal(rng, (1, 8)),
+    }
+    with pytest.raises(ValueError, match="all-Discrete"):
+        model.init(
+            rng, frame, model.initial_state(batch_size=1),
+            method=model.infer_step,
+        )
+
+
 def test_remat_preserves_loss_and_grads(policy_and_params, rng):
     """remat=True is a memory/compute trade, NOT a semantic change: loss and
     gradients must match the stored-activation path. (The tiny tokenizer has
