@@ -390,8 +390,12 @@ def stage_eval(train_dir, data_dir):
         "final_eval_loss":
             curves["eval_loss"][-1][1] if curves["eval_loss"] else None,
     }
-    with open(os.path.join(FLAGS.workdir, "learn_proof.json"), "w") as f:
+    # tmp+rename: a mid-write kill must not leave a truncated file that the
+    # pipeline's completeness check could mistake for a finished arm.
+    proof_path = os.path.join(FLAGS.workdir, "learn_proof.json")
+    with open(proof_path + ".tmp", "w") as f:
         json.dump(summary, f, indent=2)
+    os.replace(proof_path + ".tmp", proof_path)
     print(json.dumps(summary, indent=2))
 
     # Self-archive into the repo so an unattended run leaves committed-able

@@ -1,68 +1,156 @@
 #!/bin/bash
-# Round-3 flagship pipeline: wait for the oracle corpus -> long-regime
-# flagship training on the attached TPU chip -> closed-loop eval (trained +
-# random baseline). Committed in-repo because the host is reset between
-# round sessions (round-3 lesson: /root/tpu_round3.sh and the collected
-# corpus at /root/learn_proof both vanished with the reset).
+# Round-3 flagship pipeline v2: wait for the oracle corpus -> full bench
+# matrix on the attached TPU chip (guaranteed perf evidence, uncontended) ->
+# three learning-proof arms, each train+eval in its own workdir sharing the
+# one corpus:
+#   arm t1    : seq_len 1, 60k steps  — Markovian copycat-BC mitigation
+#   arm stock : seq_len 6, 12k steps  — VERDICT-prescribed reference parity
+#   arm t6long: seq_len 6, 60k steps  — the many-more-optimizer-steps lever
+#               the round-3 marginal-plateau diagnosis identified
+# Committed in-repo because the host is reset between round sessions (the
+# corpus and any /root scripts vanish; only /root/repo survives).
 #
 # Resumable at every stage: collection writes a manifest, training resumes
-# from the latest Orbax checkpoint, eval restores the latest checkpoint.
+# from the latest Orbax checkpoint, eval restores the latest checkpoint,
+# the bench driver skips nothing but is itself wedge-patient.
 # Chip-wedge-patient: a failed train invocation (axon UNAVAILABLE) is
 # retried after a cooldown instead of aborting the pipeline; SIGKILL is
 # never used (a killed claim wedges the chip server-side — round-2 lesson).
 #
-# Usage: setsid nohup bash scripts/round3_pipeline.sh > artifacts/pipeline_r03.log 2>&1 &
+# Usage: setsid nohup bash scripts/round3_pipeline.sh \
+#            > artifacts/pipeline_r03.log 2>&1 < /dev/null &
 
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-WORKDIR="${WORKDIR:-/root/learn_proof}"
-STEPS="${STEPS:-60000}"
-TAG="${TAG:-r03}"
+CORPUS="${CORPUS:-/root/learn_proof}"
 cd "$REPO"
 
 log() { echo "[pipeline $(date +%H:%M:%S)] $*"; }
 
 # ---- stage 0: wait for the corpus (collection runs in its own process) ----
-while [ ! -f "$WORKDIR/data/manifest.json" ]; do
+while [ ! -f "$CORPUS/data/manifest.json" ]; do
   log "waiting for collection manifest..."
   sleep 60
 done
-log "corpus ready: $(cat "$WORKDIR/data/manifest.json" | tr -d '\n')"
+log "corpus ready: $(tr -d '\n' < "$CORPUS/data/manifest.json")"
 
-# ---- stage 1: long-regime flagship training (patient on chip wedges) ----
-train_ok=0
-for attempt in $(seq 1 24); do
-  log "train attempt $attempt (target $STEPS steps)"
-  if python scripts/learn_proof.py --workdir "$WORKDIR" --stage train \
-    --num_steps "$STEPS" --run_tag "$TAG"; then train_ok=1; break; fi
-  rc=$?
-  log "train attempt $attempt exited rc=$rc; cooldown 300s"
+# ---- stage 1: full bench matrix (train/e2e/mfu/infer dense+pallas/ring) ----
+fail=0
+
+# The driver checkpoints incrementally with status:"running" and flips to
+# "done" even when every mode errored against a wedged chip; a complete
+# record means status=="done" AND all five expected modes recorded without
+# an error AND the on-chip ring test numerically passed (ok: true). Parsed,
+# not grepped: the *_detail stderr dumps can contain any text.
+bench_complete() {
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$REPO/TPU_VALIDATION_r03.json" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+MODES = ("bench_train", "bench_e2e", "bench_mfu",
+         "bench_infer_dense", "bench_infer_pallas")
+ring = r.get("ring_on_chip")
+ok = (
+    r.get("status") == "done"
+    and all(
+        isinstance(r.get(m), dict) and "error" not in r[m] for m in MODES
+    )
+    and isinstance(ring, dict) and ring.get("ok") is True
+)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# Retry loop mirrors the arms: a wedged chip at stage-1 start must not
+# permanently cost the round its perf evidence (tpu_validation waits out a
+# wedge between modes but never re-runs an already-errored mode; a fresh
+# invocation re-runs everything, idempotently gated by bench_complete).
+bench_ok=0
+if bench_complete; then
+  log "bench matrix already recorded (TPU_VALIDATION_r03.json); skipping"
+  bench_ok=1
+fi
+for attempt in $(seq 1 6); do
+  [ "$bench_ok" = 1 ] && break
+  log "bench matrix attempt $attempt: scripts/tpu_validation.py"
+  rc=0
+  python scripts/tpu_validation.py --out TPU_VALIDATION_r03.json || rc=$?
+  if bench_complete; then
+    log "bench matrix complete (TPU_VALIDATION_r03.json)"
+    bench_ok=1
+    break
+  fi
+  log "bench matrix attempt $attempt incomplete (rc=$rc); cooldown 300s"
   sleep 300
 done
-
-LATEST=$(ls "$WORKDIR/train/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
-if [ "$train_ok" = 1 ]; then
-  log "training done (latest checkpoint: ${LATEST:-none})"
-else
-  log "TRAINING DID NOT REACH $STEPS (latest checkpoint: ${LATEST:-none}) — retries exhausted"
+if [ "$bench_ok" != 1 ]; then
+  log "bench matrix INCOMPLETE after all attempts; continuing to arms"
+  fail=1
 fi
-[ -z "${LATEST:-}" ] && { log "no checkpoint produced; aborting"; exit 1; }
-# A partial run still gets evaluated (any 2500-step checkpoint is a valid
-# measurement point), but the log above flags it as undertrained.
 
-# ---- stage 2: closed-loop eval, trained + random baseline ----
-eval_ok=0
-for attempt in $(seq 1 12); do
-  log "eval attempt $attempt"
-  if python scripts/learn_proof.py --workdir "$WORKDIR" --stage eval \
-    --num_steps "$STEPS" --run_tag "$TAG"; then eval_ok=1; break; fi
-  rc=$?
-  log "eval attempt $attempt exited rc=$rc; cooldown 300s"
-  sleep 300
-done
-if [ "$eval_ok" = 1 ]; then
-  log "pipeline complete (trained to step ${LATEST}); artifacts under $WORKDIR and repo artifacts/"
-else
-  log "EVAL FAILED after all retries; no learn_proof.json produced"
-  exit 1
-fi
+# ---- stages 2-4: learning-proof arms ----
+# run_arm <workdir> <run_tag> <steps> <extra flags...>
+run_arm() {
+  local workdir="$1" tag="$2" steps="$3"
+  shift 3
+  mkdir -p "$workdir"
+  # -sfn: a dangling leftover link (corpus path changed between sessions)
+  # must be replaced, and plain [ -e ] can't see it (false on dangling).
+  [ -d "$workdir/data" ] && [ ! -L "$workdir/data" ] || ln -sfn "$CORPUS/data" "$workdir/data"
+
+  # Key-validated, not bare existence: a truncated file from a mid-write
+  # kill must not mark the arm complete.
+  if grep -q '"trained_successes"' "$workdir/learn_proof.json" 2>/dev/null; then
+    log "arm $tag: already complete ($(tr -d '\n ' < "$workdir/learn_proof.json" | head -c 200))"
+    return 0
+  fi
+
+  local train_ok=0 attempt rc
+  for attempt in $(seq 1 24); do
+    log "arm $tag: train attempt $attempt (target $steps steps)"
+    rc=0
+    python scripts/learn_proof.py --workdir "$workdir" --stage train \
+      --num_steps "$steps" --run_tag "$tag" "$@" || rc=$?
+    if [ "$rc" = 0 ]; then train_ok=1; break; fi
+    log "arm $tag: train attempt $attempt exited rc=$rc; cooldown 300s"
+    sleep 300
+  done
+
+  local latest
+  latest=$(ls "$workdir/train/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
+  if [ "$train_ok" = 1 ]; then
+    log "arm $tag: training done (latest checkpoint: ${latest:-none})"
+  else
+    log "arm $tag: TRAINING DID NOT REACH $steps (latest: ${latest:-none}) — retries exhausted"
+  fi
+  if [ -z "${latest:-}" ]; then
+    log "arm $tag: no checkpoint produced; skipping eval"
+    return 1
+  fi
+  # A partial run still gets evaluated (any 2500-step checkpoint is a valid
+  # measurement point), but the log above flags it as undertrained.
+
+  for attempt in $(seq 1 12); do
+    log "arm $tag: eval attempt $attempt"
+    rc=0
+    python scripts/learn_proof.py --workdir "$workdir" --stage eval \
+      --num_steps "$steps" --run_tag "$tag" "$@" || rc=$?
+    if [ "$rc" = 0 ]; then
+      log "arm $tag: complete; artifacts under $workdir and repo artifacts/"
+      return 0
+    fi
+    log "arm $tag: eval attempt $attempt exited rc=$rc; cooldown 300s"
+    sleep 300
+  done
+  log "arm $tag: EVAL FAILED after all retries"
+  return 1
+}
+
+run_arm /root/learn_proof_t1     r03t1     60000 --seq_len 1 || fail=1
+run_arm /root/learn_proof_stock  r03stock  12000 --seq_len 6 || fail=1
+run_arm /root/learn_proof_t6long r03t6long 60000 --seq_len 6 || fail=1
+
+log "pipeline finished (fail=$fail)"
+exit "$fail"
