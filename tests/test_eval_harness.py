@@ -137,6 +137,29 @@ def test_full_protocol_tiny(tiny_policy_setup):
     assert results["episodes_per_reward"] == 2
 
 
+def test_full_protocol_tiny_t1(tiny_policy_setup):
+    """Closed-loop eval at time_sequence_length=1 — the Markovian
+    mitigation arm (`scripts/learn_proof.py --seq_len 1`) must not hit a
+    T=1-only eval bug hours into an unattended pipeline. Params are
+    T-invariant (test_rt1.py::test_params_are_time_sequence_length_invariant),
+    so the T=3 fixture's variables drive a T=1 clone directly."""
+    model, variables = tiny_policy_setup
+    policy = RT1EvalPolicy(model.clone(time_sequence_length=1), variables)
+    results = evaluate_policy(
+        policy,
+        reward_names=("block2block",),
+        num_evals_per_reward=1,
+        max_episode_steps=5,
+        block_mode=blocks.BlockMode.BLOCK_4,
+        seed=0,
+        env_kwargs=dict(
+            target_height=64, target_width=114, sequence_length=1
+        ),
+    )
+    assert results["episodes_per_reward"] == 1
+    assert 0 <= results["successes"]["block2block"] <= 1
+
+
 @pytest.mark.slow
 def test_lava_eval_policy_paths():
     """LavaEvalPolicy: history slicing, clip tokenization from instruction
