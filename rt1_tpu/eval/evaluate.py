@@ -27,6 +27,48 @@ NUM_EVALS_PER_REWARD = 10
 MAX_EPISODE_STEPS = 80
 
 
+class OracleEvalPolicy:
+    """The scripted RRT expert run under the *identical* eval protocol.
+
+    The protocol's ceiling is far below 100%: the oracle solves only a
+    fraction of oracle-validated inits within the reference's 80-step
+    budget (round-3 diagnosis — demos keep only <=80-step successes, so the
+    corpus is the easy subset). Trained-policy success rates must be read
+    against this expert baseline, not against 1.0.
+
+    Uses privileged simulator state (`env.compute_state()`), which the
+    observation-driven policy interface doesn't carry, so `evaluate_policy`
+    hands the freshly built env to any policy exposing `bind_env`. No
+    explicit planning here: the oracle plans lazily inside `action` (and
+    replans on instruction change), which is exactly right given that
+    `run_episode` resets the policy *before* the env exists in its
+    episode-final state.
+    """
+
+    def __init__(self, seed=0):
+        self._seed = seed
+        self._env = None
+        self._oracle = None
+
+    def bind_env(self, env):
+        self._env = env
+        self._oracle = RRTPushOracle(env, use_ee_planner=True, seed=self._seed)
+
+    def reset(self):
+        if self._oracle is None:
+            raise RuntimeError(
+                "OracleEvalPolicy requires evaluate_policy (bind_env) to "
+                "attach the env before rollouts."
+            )
+        self._oracle.reset()
+
+    def action(self, observation):
+        del observation  # privileged: reads simulator state directly
+        return np.asarray(
+            self._oracle.action(self._env.compute_state()), np.float32
+        )
+
+
 def build_eval_env(
     reward_name="block2block",
     block_mode=blocks.BlockMode.BLOCK_8,
@@ -130,6 +172,8 @@ def evaluate_policy(
             embedder=embedder,
             **(env_kwargs or {}),
         )
+        if hasattr(policy, "bind_env"):  # privileged policies (oracle)
+            policy.bind_env(env)
         for ep in range(num_evals_per_reward):
             success, steps, frames = run_episode(
                 env,

@@ -362,6 +362,12 @@ def stage_eval(train_dir, data_dir):
     policy = _restore_policy(train_dir, data_dir)
     trained = _run_protocol(policy, "trained", write_videos=True)
     random_results = _run_protocol(RandomPolicy(seed=EVAL_SEED), "random")
+    # The protocol's expert ceiling (round-3 diagnosis: the RRT oracle solves
+    # well under 100% of oracle-validated inits inside the 80-step budget);
+    # trained/random read against THIS bar, not 1.0.
+    from rt1_tpu.eval.evaluate import OracleEvalPolicy
+
+    oracle_results = _run_protocol(OracleEvalPolicy(seed=EVAL_SEED), "oracle")
     tag = os.path.basename(os.path.normpath(FLAGS.workdir))
     _copy_proof_videos(video_dir, prefix=f"{tag}_{FLAGS.run_tag}")
 
@@ -382,10 +388,13 @@ def stage_eval(train_dir, data_dir):
         "eval_episodes": FLAGS.eval_episodes,
         "trained_successes": trained["successes"][REWARD],
         "random_successes": random_results["successes"][REWARD],
+        "oracle_successes": oracle_results["successes"][REWARD],
         "trained_mean_episode_length":
             trained["mean_episode_length"][REWARD],
         "random_mean_episode_length":
             random_results["mean_episode_length"][REWARD],
+        "oracle_mean_episode_length":
+            oracle_results["mean_episode_length"][REWARD],
         "final_train_loss": curves["loss"][-1][1] if curves["loss"] else None,
         "final_eval_loss":
             curves["eval_loss"][-1][1] if curves["eval_loss"] else None,

@@ -137,6 +137,35 @@ def test_full_protocol_tiny(tiny_policy_setup):
     assert results["episodes_per_reward"] == 2
 
 
+def test_oracle_eval_policy_protocol():
+    """The privileged expert baseline under the standard protocol: bind_env
+    wiring, lazy per-episode planning, and a sanity bar — the RRT oracle
+    solves most block2block episodes within 200 steps (it is the same
+    policy that produced the training demos)."""
+    from rt1_tpu.eval.evaluate import OracleEvalPolicy
+
+    results = evaluate_policy(
+        OracleEvalPolicy(seed=7),
+        reward_names=("block2block",),
+        num_evals_per_reward=3,
+        max_episode_steps=200,
+        block_mode=blocks.BlockMode.BLOCK_4,
+        seed=7,
+        env_kwargs=dict(
+            target_height=64, target_width=114, sequence_length=3
+        ),
+    )
+    assert results["successes"]["block2block"] >= 1
+    assert len(results["mean_episode_length"]) == 1
+
+
+def test_oracle_eval_policy_requires_bind():
+    from rt1_tpu.eval.evaluate import OracleEvalPolicy
+
+    with pytest.raises(RuntimeError, match="bind_env"):
+        OracleEvalPolicy().reset()
+
+
 def test_full_protocol_tiny_t1(tiny_policy_setup):
     """Closed-loop eval at time_sequence_length=1 — the Markovian
     mitigation arm (`scripts/learn_proof.py --seq_len 1`) must not hit a
