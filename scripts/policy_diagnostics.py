@@ -42,16 +42,103 @@ flags.DEFINE_integer("diag_episodes", 10, "Diagnostic episodes.")
 flags.DEFINE_integer("max_steps", 80, "Step budget per episode.")
 flags.DEFINE_integer("diag_seed", 20_000, "Env seed (disjoint from train/eval).")
 flags.DEFINE_string("out", "", "Output JSON (default: <workdir>/diagnostics.json)")
+flags.DEFINE_bool(
+    "corpus_entropy", False,
+    "Compute the corpus' marginal action-token entropy (the token-CE "
+    "plateau bar, RESULTS.md round-3 diagnosis) instead of closed-loop "
+    "diagnostics. Needs only <workdir>/data, no checkpoint.")
+flags.DEFINE_integer(
+    "entropy_episodes", 200, "Train episodes to scan for --corpus_entropy.")
+
+
+def corpus_entropy(data_dir, n_episodes, vocab_size=256):
+    """Marginal token entropy of the demo corpus, in nats per action token.
+
+    A policy that fits only the marginal action distribution (ignoring
+    observations) plateaus at this cross-entropy; a val CE above it means
+    the model hasn't even matched the marginal, and CE below it is the
+    first evidence of input-dependence. Exact for T=1; for T>1 the bar is
+    approximate — windowing pads each episode's first window-1 positions by
+    repeating step 0 (pipeline.py), reweighting the label marginal slightly.
+    The `displayed_loss_at` entries convert to the reference loss scaling
+    (raw mean-per-token CE divided by b*t*(I+A),
+    transformer_network.py:314-319) for this repo's standard arm configs,
+    assuming the flagship 8 image tokens (I+A=11).
+    """
+    import glob
+
+    from rt1_tpu.data.episodes import load_episode, read_reference_episode
+    from rt1_tpu.models.action_tokenizer import tokenize
+    from rt1_tpu.specs import language_table_action_space
+
+    space = language_table_action_space()
+    paths = sorted(glob.glob(os.path.join(data_dir, "train", "episode_*.np*")))
+    if not paths:
+        raise FileNotFoundError(f"no train episodes under {data_dir}")
+    paths = paths[:n_episodes]
+    counts = None
+    for path in paths:
+        ep = (
+            read_reference_episode(path)
+            if path.endswith(".npy")
+            else load_episode(path)
+        )
+        actions = np.asarray(ep["action"], np.float32)  # (T, 2)
+        tokens = np.asarray(
+            tokenize(
+                space,
+                {
+                    "terminate_episode": np.asarray(
+                        ep["is_terminal"], np.int32
+                    ),
+                    "action": actions,
+                },
+                vocab_size,
+            )
+        )  # (T, A)
+        if counts is None:
+            counts = np.zeros((tokens.shape[-1], vocab_size), np.int64)
+        for pos in range(tokens.shape[-1]):
+            counts[pos] += np.bincount(tokens[:, pos], minlength=vocab_size)
+
+    def entropy(c):
+        p = c / c.sum()
+        nz = p[p > 0]
+        return float(-(nz * np.log(nz)).sum())
+
+    per_token = [entropy(c) for c in counts]
+    mean_nats = float(np.mean(per_token))
+    tokens_per_step = 11  # flagship: I=8 image + A=3 action tokens
+    return {
+        "episodes_scanned": len(paths),
+        "per_token_entropy_nats": per_token,
+        "mean_entropy_nats": mean_nats,
+        "displayed_loss_assumes": "8 image tokens (I+A=11); T>1 bars are "
+                                  "approximate (first-frame window padding "
+                                  "reweights the label marginal)",
+        "displayed_loss_at": {
+            f"b{b}_T{t}": mean_nats / (b * t * tokens_per_step)
+            for b, t in ((32, 1), (32, 6), (16, 1), (8, 6))
+        },
+    }
 
 
 def main(argv):
     del argv
+    data_dir = os.path.join(FLAGS.workdir, "data")
+    train_dir = os.path.join(FLAGS.workdir, "train")
+    if FLAGS.corpus_entropy:
+        # Before the env/eval imports: this mode needs only numpy + data.
+        report = corpus_entropy(data_dir, FLAGS.entropy_episodes)
+        out = FLAGS.out or os.path.join(FLAGS.workdir, "corpus_entropy.json")
+        with open(out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(json.dumps(report, indent=2))
+        return
+
     from rt1_tpu.envs import blocks
     from rt1_tpu.envs.oracles import RRTPushOracle
     from rt1_tpu.eval.evaluate import build_eval_env
-
-    data_dir = os.path.join(FLAGS.workdir, "data")
-    train_dir = os.path.join(FLAGS.workdir, "train")
     learn_proof._check_train_meta(train_dir, "diagnostics",
                                   learn_proof.EVAL_META_KEYS)
     policy = learn_proof._restore_policy(train_dir, data_dir)
