@@ -33,9 +33,27 @@ def collect_episode(
     embedder,
     max_steps=80,
     image_hw=None,
+    exec_noise_std=0.0,
+    noise_rng=None,
 ):
-    """One oracle rollout -> episode dict, or None if init/solve failed."""
+    """One oracle rollout -> episode dict, or None if init/solve failed.
+
+    `exec_noise_std` > 0 enables DART-style noise injection (Laskey et al.
+    2017): the EXECUTED action is the oracle's action plus Gaussian noise,
+    while the RECORDED label stays the clean corrective action the oracle
+    computed for the actually-reached state. The corpus then covers
+    off-distribution states with recovery labels — the scale-independent
+    mitigation for the round-3 closed-loop drift failure (a policy trained
+    on noise-free demos collapses to the marginal action the moment its
+    own imperfect actions leave the demo state distribution; diagnosis in
+    RESULTS.md, `artifacts/cpu_t1_diag_ck7500.json`). The reference never
+    needed this because its corpus is human teleop, which carries this
+    state coverage naturally.
+    """
     import cv2
+
+    if exec_noise_std and noise_rng is None:
+        raise ValueError("exec_noise_std > 0 requires a noise_rng")
 
     obs = env.reset()
     oracle.reset()
@@ -57,7 +75,13 @@ def collect_episode(
                 interpolation=cv2.INTER_LINEAR,
             )
         action = oracle.action(env.compute_state())
-        obs, _, done, _ = env.step(action)
+        exec_action = action
+        if exec_noise_std:
+            action = np.asarray(action, np.float32)
+            exec_action = action + noise_rng.normal(
+                0.0, exec_noise_std, size=action.shape
+            ).astype(np.float32)
+        obs, _, done, _ = env.step(exec_action)
         steps["action"].append(np.asarray(action, np.float32))
         steps["is_first"].append(t == 0)
         steps["is_terminal"].append(bool(done))
@@ -86,11 +110,13 @@ def collect_dataset(
     embedder="hash",
     image_hw=None,
     progress_every=25,
+    exec_noise_std=0.0,
 ):
     """Collect `num_episodes` successful demos and write split directories.
 
     Split sizing follows the reference's 7800/100/100 proportions
-    (`rlds_np_convert.py:57-66`).
+    (`rlds_np_convert.py:57-66`). `exec_noise_std` enables DART noise
+    injection (see `collect_episode`).
     """
     from rt1_tpu.data.episodes import save_episode
 
@@ -101,6 +127,7 @@ def collect_dataset(
     )
     oracle = RRTPushOracle(env, use_ee_planner=True, seed=seed)
     embed_fn = get_embedder(embedder)
+    noise_rng = np.random.default_rng(seed + 7919)
 
     counts = {name: 0 for name, _ in splits}
     quotas = _split_quotas(splits, num_episodes)
@@ -112,7 +139,8 @@ def collect_dataset(
     while collected < num_episodes:
         attempts += 1
         ep = collect_episode(
-            env, oracle, embed_fn, max_steps=max_steps, image_hw=image_hw
+            env, oracle, embed_fn, max_steps=max_steps, image_hw=image_hw,
+            exec_noise_std=exec_noise_std, noise_rng=noise_rng,
         )
         if ep is None:
             continue
@@ -139,6 +167,7 @@ def collect_dataset(
         image_hw=image_hw,
         episodes=num_episodes,
         seed=seed,
+        exec_noise_std=exec_noise_std,
     )
     return counts
 
@@ -215,6 +244,7 @@ def _collect_shard(shard_dir, count, seed, kwargs):
     )
     oracle = RRTPushOracle(env, use_ee_planner=True, seed=seed)
     embed_fn = get_embedder(kwargs.get("embedder", "hash"))
+    noise_rng = np.random.default_rng(seed + 7919)
     os.makedirs(shard_dir, exist_ok=True)
     done = 0
     while done < count:
@@ -224,6 +254,8 @@ def _collect_shard(shard_dir, count, seed, kwargs):
             embed_fn,
             max_steps=kwargs.get("max_steps", 80),
             image_hw=kwargs.get("image_hw"),
+            exec_noise_std=kwargs.get("exec_noise_std", 0.0),
+            noise_rng=noise_rng,
         )
         if ep is None:
             continue
@@ -243,6 +275,7 @@ def collect_dataset_parallel(
     splits=(("train", 0.975), ("val", 0.0125), ("test", 0.0125)),
     embedder="hash",
     image_hw=None,
+    exec_noise_std=0.0,
 ):
     """`collect_dataset` fanned out over `workers` processes.
 
@@ -264,6 +297,7 @@ def collect_dataset_parallel(
         embedder=embedder,
         max_steps=max_steps,
         image_hw=image_hw,
+        exec_noise_std=exec_noise_std,
     )
     shard_root = os.path.join(data_dir, "_shards")
     # A crashed prior run leaves stale shard files that os.walk would
@@ -322,6 +356,7 @@ def collect_dataset_parallel(
         episodes=num_episodes,
         seed=seed,
         workers=workers,
+        exec_noise_std=exec_noise_std,
     )
     return counts
 
@@ -344,6 +379,7 @@ def main(argv):
         seed=FLAGS.seed,
         max_steps=FLAGS.max_steps,
         embedder=FLAGS.embedder,
+        exec_noise_std=FLAGS.exec_noise_std,
     )
     print("done:", counts)
 
@@ -359,4 +395,8 @@ if __name__ == "__main__":
     flags.DEFINE_integer("max_steps", 80, "Max steps per episode.")
     flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
     flags.DEFINE_integer("workers", 1, "Parallel collection processes.")
+    flags.DEFINE_float(
+        "exec_noise_std", 0.0,
+        "DART execution-noise std: executed action = oracle action + "
+        "N(0, std); the recorded label stays clean (see collect_episode).")
     app.run(main)

@@ -39,6 +39,13 @@ flags.DEFINE_integer("workers", 12, "Parallel collection processes.")
 flags.DEFINE_integer("num_steps", 20000, "Training steps.")
 flags.DEFINE_integer("eval_episodes", 20, "Closed-loop episodes per policy.")
 flags.DEFINE_string("stage", "all", "all | collect | train | eval")
+flags.DEFINE_float(
+    "exec_noise_std", 0.0,
+    "DART execution-noise std at collection: executed action = oracle "
+    "action + N(0, std), recorded label stays the clean corrective action "
+    "(rt1_tpu/data/collect.py::collect_episode). Covers off-distribution "
+    "states with recovery labels — the round-3 mitigation for closed-loop "
+    "drift. 0 = noise-free reference-style demos.")
 flags.DEFINE_string("block_mode", "BLOCK_4", "Board variant.")
 flags.DEFINE_string("embedder", "ngram", "Instruction embedder.")
 flags.DEFINE_enum(
@@ -109,6 +116,15 @@ def stage_collect():
     data_dir = os.path.join(FLAGS.workdir, "data")
     manifest = read_manifest(data_dir)
     if manifest is not None:
+        # A pre-DART manifest (no exec_noise_std key) is a clean corpus.
+        recorded = manifest.get("exec_noise_std", 0.0)
+        if recorded != FLAGS.exec_noise_std:
+            raise ValueError(
+                f"collect: corpus at {data_dir} was collected with "
+                f"exec_noise_std={recorded}, flags say "
+                f"{FLAGS.exec_noise_std}. Point --workdir at a fresh "
+                "directory (or pass the matching noise level)."
+            )
         print(f"collect: already done ({manifest['episodes']} episodes)")
         return data_dir
     counts = collect_dataset_parallel(
@@ -118,6 +134,7 @@ def stage_collect():
         block_mode=blocks.BlockMode(FLAGS.block_mode),
         reward_name=REWARD,
         embedder=FLAGS.embedder,
+        exec_noise_std=FLAGS.exec_noise_std,
     )
     print("collect:", counts)
     return data_dir
@@ -346,10 +363,17 @@ def _plot_curves(curves, path):
 
 
 def stage_eval(train_dir, data_dir):
-    from rt1_tpu.data.collect import check_embedder_compatibility
+    from rt1_tpu.data.collect import check_embedder_compatibility, read_manifest
 
     _check_train_meta(train_dir, "eval", EVAL_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="eval")
+    # Corpus noise level from the manifest (ground truth), not the flag:
+    # the eval stage never collects, so the flag could silently mis-record.
+    manifest = read_manifest(data_dir)
+    corpus_noise = (
+        manifest.get("exec_noise_std", 0.0)
+        if manifest is not None else FLAGS.exec_noise_std
+    )
     # Clear stale videos from earlier evals of this workdir: filenames carry
     # the success/failure tag, so a rerun would otherwise leave a mixture
     # and the success-preferring archive below could stage an outcome the
@@ -379,6 +403,7 @@ def stage_eval(train_dir, data_dir):
         "block_mode": FLAGS.block_mode,
         "embedder": FLAGS.embedder,
         "episodes_collected": FLAGS.episodes,
+        "exec_noise_std": corpus_noise,
         "train_steps": FLAGS.num_steps,
         "seq_len": FLAGS.seq_len,
         "focal_gamma": FLAGS.focal_gamma,

@@ -144,6 +144,84 @@ def test_metrics_helpers(tmp_path):
 
 
 @pytest.mark.slow
+def test_collect_dart_noise_records_clean_labels(tmp_path):
+    """DART collection executes noisy but records the oracle's clean label.
+
+    An env wrapper captures what `env.step` actually executed; the episode
+    must record something ELSE (the clean corrective action), offset by
+    roughly the configured noise scale. If collection regresses to
+    recording the executed noisy action, the mismatch assertions fail.
+    Also pins the manifest stamp that keeps noisy and clean corpora
+    distinguishable.
+    """
+    import json
+
+    from rt1_tpu.data.collect import collect_episode, collect_dataset
+    from rt1_tpu.envs import LanguageTable, blocks
+    from rt1_tpu.envs.oracles import RRTPushOracle
+    from rt1_tpu.envs.rewards import BlockToBlockReward
+    from rt1_tpu.eval.embedding import get_embedder
+
+    class StepRecorder:
+        def __init__(self, env):
+            self._env = env
+            self.executed = []
+
+        def __getattr__(self, name):
+            return getattr(self._env, name)
+
+        def reset(self):
+            return self._env.reset()
+
+        def step(self, action):
+            self.executed.append(np.asarray(action, np.float32).copy())
+            return self._env.step(action)
+
+    env = StepRecorder(
+        LanguageTable(
+            block_mode=blocks.BlockMode.BLOCK_4,
+            reward_factory=BlockToBlockReward,
+            seed=3,
+        )
+    )
+    oracle = RRTPushOracle(env, use_ee_planner=True, seed=3)
+    noise_rng = np.random.default_rng(11)
+    ep = None
+    while ep is None:  # noise can fail an episode; the filter drops those
+        env.executed.clear()
+        ep = collect_episode(
+            env, oracle, get_embedder("hash"), max_steps=160,
+            image_hw=(48, 48), exec_noise_std=0.01, noise_rng=noise_rng,
+        )
+    executed = np.stack(env.executed)
+    recorded = ep["action"]
+    assert executed.shape == recorded.shape
+    delta = executed - recorded
+    assert not np.allclose(delta, 0.0)  # executed = recorded + noise
+    assert 0.003 < np.abs(delta).mean() < 0.03  # ~N(0, 0.01) magnitude
+    # Noise-free collection executes exactly what it records.
+    env.executed.clear()
+    ep = None
+    while ep is None:
+        env.executed.clear()
+        ep = collect_episode(
+            env, oracle, get_embedder("hash"), max_steps=160,
+            image_hw=(48, 48),
+        )
+    np.testing.assert_array_equal(np.stack(env.executed), ep["action"])
+
+    # Manifest stamps the noise level.
+    collect_dataset(
+        str(tmp_path / "noisy"), 1,
+        block_mode=blocks.BlockMode.BLOCK_4, seed=3, max_steps=160,
+        image_hw=(48, 48), progress_every=0, splits=(("train", 1.0),),
+        exec_noise_std=0.01,
+    )
+    with open(tmp_path / "noisy" / "manifest.json") as f:
+        assert json.load(f)["exec_noise_std"] == 0.01
+
+
+@pytest.mark.slow
 def test_collect_lifecycle(tmp_path):
     """collect -> real-data train: the hermetic data-generation path."""
     from rt1_tpu.data.collect import collect_dataset
