@@ -331,14 +331,37 @@ def collect_dataset_parallel(
         raise RuntimeError(
             f"workers produced {len(all_eps)} episodes, need {num_episodes}"
         )
-    quotas = _split_quotas(splits, num_episodes)
+    return _deal_shards(
+        data_dir,
+        shard_root,
+        all_eps[:num_episodes],
+        splits,
+        seed,
+        embedder=embedder,
+        reward=reward_name,
+        block_mode=block_mode.value,
+        max_steps=max_steps,
+        image_hw=image_hw,
+        workers=workers,
+        exec_noise_std=exec_noise_std,
+    )
+
+
+def _deal_shards(data_dir, shard_root, all_eps, splits, seed,
+                 **manifest_fields):
+    """Shuffle shard episodes, deal them into split dirs, stamp the manifest.
+
+    The shuffle across worker shards is what mixes every worker seed into
+    each split. Shared by the normal parallel-collection finish and by
+    `finalize_shards` (partial-corpus salvage).
+    """
+    quotas = _split_quotas(splits, len(all_eps))
     counts = {name: 0 for name, _ in splits}
-    # Shuffle episodes across worker shards, then deal contiguous quota
-    # blocks — the shuffle is what mixes every worker seed into each split.
     order = []
     for name, _ in splits:
         order.extend([name] * quotas[name])
     rng = np.random.default_rng(seed)
+    all_eps = list(all_eps)
     rng.shuffle(all_eps)
     for path, name in zip(all_eps, order):
         dst = os.path.join(data_dir, name)
@@ -347,18 +370,57 @@ def collect_dataset_parallel(
         counts[name] += 1
     shutil.rmtree(shard_root, ignore_errors=True)
     write_manifest(
-        data_dir,
-        embedder=embedder,
-        reward=reward_name,
-        block_mode=block_mode.value,
-        max_steps=max_steps,
-        image_hw=image_hw,
-        episodes=num_episodes,
-        seed=seed,
-        workers=workers,
-        exec_noise_std=exec_noise_std,
+        data_dir, episodes=len(all_eps), seed=seed, **manifest_fields
     )
     return counts
+
+
+def finalize_shards(
+    data_dir,
+    splits=(("train", 0.975), ("val", 0.0125), ("test", 0.0125)),
+    seed=0,
+    **manifest_fields,
+):
+    """Deal whatever `_shards/` holds into split dirs and stamp a manifest.
+
+    Salvage path for a collection stopped early (slow host, session
+    deadline): `collect_dataset_parallel`'s spawn workers write shard files
+    continuously and outlive a killed parent, so the episodes on disk are
+    complete and valid — only the final deal + manifest is missing. The
+    caller must pass manifest fields matching how collection was launched
+    (embedder, reward, block_mode, exec_noise_std, ...): shard files don't
+    record them.
+    """
+    shard_root = os.path.join(data_dir, "_shards")
+    for name, _ in splits:
+        split_dir = os.path.join(data_dir, name)
+        if os.path.isdir(split_dir) and os.listdir(split_dir):
+            raise RuntimeError(
+                f"refusing to finalize: {split_dir} already has episodes "
+                "(a prior deal?) — dealing would renumber from episode_0 "
+                "and silently mix two corpora under one manifest."
+            )
+    candidates = sorted(
+        os.path.join(root, f)
+        for root, _, files in os.walk(shard_root)
+        for f in files
+        if f.endswith(".npz")
+    )
+    all_eps = []
+    for path in candidates:
+        try:
+            # A worker killed inside np.savez leaves a truncated zip that
+            # the loader would only discover mid-training.
+            with np.load(path) as z:
+                z.files  # noqa: B018 — forces the header parse
+            all_eps.append(path)
+        except Exception as e:
+            print(f"finalize: skipping corrupt shard file {path}: {e!r}")
+    if not all_eps:
+        raise RuntimeError(f"no intact shard episodes under {shard_root}")
+    return _deal_shards(
+        data_dir, shard_root, all_eps, splits, seed, **manifest_fields
+    )
 
 
 def main(argv):
@@ -366,6 +428,20 @@ def main(argv):
     from absl import flags
 
     FLAGS = flags.FLAGS
+    if FLAGS.finalize_shards:
+        counts = finalize_shards(
+            FLAGS.data_dir,
+            seed=FLAGS.seed,
+            embedder=FLAGS.embedder,
+            reward=FLAGS.reward,
+            block_mode=blocks.BlockMode(FLAGS.block_mode).value,
+            max_steps=FLAGS.max_steps,
+            image_hw=None,
+            workers=FLAGS.workers,
+            exec_noise_std=FLAGS.exec_noise_std,
+        )
+        print("finalized:", counts)
+        return
     collect = (
         collect_dataset
         if FLAGS.workers <= 1
@@ -399,4 +475,10 @@ if __name__ == "__main__":
         "exec_noise_std", 0.0,
         "DART execution-noise std: executed action = oracle action + "
         "N(0, std); the recorded label stays clean (see collect_episode).")
+    flags.DEFINE_bool(
+        "finalize_shards", False,
+        "Deal an interrupted parallel collection's _shards/ into split "
+        "dirs + manifest instead of collecting. Manifest fields come from "
+        "the flags — pass the SAME values the collection was launched "
+        "with (shard files don't record them).")
     app.run(main)

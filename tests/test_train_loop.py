@@ -143,6 +143,41 @@ def test_metrics_helpers(tmp_path):
     )
 
 
+def test_finalize_shards_salvages_partial_collection(tmp_path):
+    """An interrupted parallel collection leaves only `_shards/`; the
+    finalize path must deal whatever exists into splits and stamp a
+    manifest recording the TRUE (partial) episode count."""
+    import json
+
+    from rt1_tpu.data.collect import finalize_shards
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+
+    rng = np.random.default_rng(0)
+    data_dir = str(tmp_path / "data")
+    for w in range(2):
+        shard = os.path.join(data_dir, "_shards", f"shard_{w}")
+        os.makedirs(shard)
+        for i in range(5):
+            save_episode(
+                os.path.join(shard, f"episode_{i}.npz"),
+                generate_synthetic_episode(rng, num_steps=4),
+            )
+
+    counts = finalize_shards(
+        data_dir,
+        splits=(("train", 0.8), ("val", 0.2)),
+        embedder="hash",
+        exec_noise_std=0.005,
+    )
+    assert counts == {"train": 8, "val": 2}
+    assert len(os.listdir(os.path.join(data_dir, "train"))) == 8
+    assert not os.path.isdir(os.path.join(data_dir, "_shards"))
+    with open(os.path.join(data_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["episodes"] == 10
+    assert manifest["exec_noise_std"] == 0.005
+
+
 @pytest.mark.slow
 def test_collect_dart_noise_records_clean_labels(tmp_path):
     """DART collection executes noisy but records the oracle's clean label.
