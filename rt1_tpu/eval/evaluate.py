@@ -27,6 +27,22 @@ NUM_EVALS_PER_REWARD = 10
 MAX_EPISODE_STEPS = 80
 
 
+class RandomEvalPolicy:
+    """Uniform actions in the eval policy's clip range — the chance
+    baseline every learning proof is read against."""
+
+    def __init__(self, seed=0, low=-0.03, high=0.03):
+        self._rng = np.random.default_rng(seed)
+        self._low, self._high = low, high
+
+    def reset(self):
+        pass
+
+    def action(self, observation):
+        del observation
+        return self._rng.uniform(self._low, self._high, 2).astype("float32")
+
+
 class OracleEvalPolicy:
     """The scripted RRT expert run under the *identical* eval protocol.
 

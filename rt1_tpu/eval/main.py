@@ -97,7 +97,9 @@ def main(argv):
 
     FLAGS = flags.FLAGS
     config = FLAGS.config
-    if not FLAGS.allow_embedder_mismatch:
+    if not FLAGS.allow_embedder_mismatch and not FLAGS.baseline:
+        # (Baselines never consume instruction embeddings — and need no
+        # checkpoint, so there may be no data_manifest to check against.)
         # The train CLI stamped the training data's embedder next to the
         # checkpoints; evaluating with a different provider would feed the
         # policy embeddings from a foreign domain and silently score ~random.
@@ -110,9 +112,18 @@ def main(argv):
             "--allow_embedder_mismatch to override",
             manifest_name="data_manifest.json",
         )
-    policy, step, history_keys = load_policy_from_workdir(
-        config, FLAGS.workdir
-    )
+    if FLAGS.baseline == "oracle":
+        from rt1_tpu.eval.evaluate import OracleEvalPolicy
+
+        policy, step, history_keys = OracleEvalPolicy(seed=FLAGS.seed), -1, None
+    elif FLAGS.baseline == "random":
+        from rt1_tpu.eval.evaluate import RandomEvalPolicy
+
+        policy, step, history_keys = RandomEvalPolicy(seed=FLAGS.seed), -1, None
+    else:
+        policy, step, history_keys = load_policy_from_workdir(
+            config, FLAGS.workdir
+        )
     env_kwargs = dict(
         target_height=config.data.height,
         target_width=config.data.width,
@@ -159,5 +170,11 @@ if __name__ == "__main__":
         "allow_embedder_mismatch", False,
         "Evaluate even if the checkpoint's data manifest records a "
         "different instruction embedder.")
+    flags.DEFINE_enum(
+        "baseline", "", ["", "oracle", "random"],
+        "Evaluate a baseline instead of the checkpoint: 'oracle' = the "
+        "scripted RRT expert under the identical protocol (the success "
+        "ceiling — well below 100% inside the 80-step budget), 'random' = "
+        "uniform +-0.03 actions (chance). Checkpoint restore is skipped.")
     flags.mark_flags_as_required(["config"])
     app.run(main)

@@ -240,20 +240,6 @@ def _restore_policy(train_dir, data_dir):
     return RT1EvalPolicy(model, variables)
 
 
-class RandomPolicy:
-    """Uniform actions in the eval policy's clip range — the chance baseline."""
-
-    def __init__(self, seed=0, low=-0.03, high=0.03):
-        import numpy as np
-
-        self._rng = np.random.default_rng(seed)
-        self._low, self._high = low, high
-
-    def reset(self):
-        pass
-
-    def action(self, observation):
-        return self._rng.uniform(self._low, self._high, 2).astype("float32")
 
 
 def _run_protocol(policy, tag, write_videos=False):
@@ -385,11 +371,12 @@ def stage_eval(train_dir, data_dir):
 
     policy = _restore_policy(train_dir, data_dir)
     trained = _run_protocol(policy, "trained", write_videos=True)
-    random_results = _run_protocol(RandomPolicy(seed=EVAL_SEED), "random")
+    from rt1_tpu.eval.evaluate import OracleEvalPolicy, RandomEvalPolicy
+
+    random_results = _run_protocol(RandomEvalPolicy(seed=EVAL_SEED), "random")
     # The protocol's expert ceiling (round-3 diagnosis: the RRT oracle solves
     # well under 100% of oracle-validated inits inside the 80-step budget);
     # trained/random read against THIS bar, not 1.0.
-    from rt1_tpu.eval.evaluate import OracleEvalPolicy
 
     oracle_results = _run_protocol(OracleEvalPolicy(seed=EVAL_SEED), "oracle")
     tag = os.path.basename(os.path.normpath(FLAGS.workdir))

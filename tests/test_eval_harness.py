@@ -159,6 +159,20 @@ def test_oracle_eval_policy_protocol():
     assert len(results["mean_episode_length"]) == 1
 
 
+def test_env_bench_mode(capsys):
+    """bench.py --mode env: host-only simulator throughput, no accelerator
+    claim, one parseable JSON headline."""
+    import json
+
+    import bench
+
+    bench.env_bench(None, n_steps=20)
+    headline = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert headline["metric"] == "env_control_steps_per_sec"
+    assert headline["value"] > 0
+    assert headline["unit"] == "steps/s"
+
+
 def test_oracle_eval_policy_requires_bind():
     from rt1_tpu.eval.evaluate import OracleEvalPolicy
 
