@@ -143,6 +143,36 @@ def test_metrics_helpers(tmp_path):
     )
 
 
+def test_corpus_entropy_tool(tmp_path):
+    """The marginal-plateau bar tool: 3 per-token entropies (terminate,
+    x, y), nonnegative, and displayed-loss conversions under the
+    reference scaling present for the standard arm configs."""
+    import sys
+
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import policy_diagnostics
+
+    rng = np.random.default_rng(1)
+    train = tmp_path / "data" / "train"
+    os.makedirs(train)
+    for i in range(3):
+        save_episode(
+            str(train / f"episode_{i}.npz"),
+            generate_synthetic_episode(rng, num_steps=12),
+        )
+    report = policy_diagnostics.corpus_entropy(str(tmp_path / "data"), 3)
+    assert report["episodes_scanned"] == 3
+    assert len(report["per_token_entropy_nats"]) == 3
+    assert all(e >= 0 for e in report["per_token_entropy_nats"])
+    # mean over tokens, in nats, bounded by ln(vocab)=ln(256)
+    assert 0 <= report["mean_entropy_nats"] <= np.log(256)
+    assert report["displayed_loss_at"]["b16_T1"] == pytest.approx(
+        report["mean_entropy_nats"] / (16 * 11)
+    )
+
+
 def test_finalize_shards_salvages_partial_collection(tmp_path):
     """An interrupted parallel collection leaves only `_shards/`; the
     finalize path must deal whatever exists into splits and stamp a
