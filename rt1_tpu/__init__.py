@@ -30,3 +30,13 @@ Package map (subpackage → reference counterpart):
 """
 
 __version__ = "0.1.0"
+
+# Chip-claim guard (mechanism, not documentation): an axon-enabled process
+# importing the framework either becomes the single allowed TPU claimant or
+# is refused loudly while another live claimant exists — BEFORE any jax
+# backend init can dial the relay and collide with the in-flight claim.
+# CPU-pinned processes (PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu) pass
+# through untouched. See rt1_tpu/chip_claim.py for the failure history.
+from rt1_tpu import chip_claim as _chip_claim
+
+_chip_claim.guard()

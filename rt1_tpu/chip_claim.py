@@ -1,0 +1,376 @@
+"""Mutual exclusion for the single attached axon TPU chip — as a mechanism.
+
+Two full round sessions of TPU evidence were lost to *claim wedges*: an axon
+client killed (or exiting) mid-claim leaves the chip grant held server-side,
+and every later claim hangs ~25 min then fails UNAVAILABLE, for hours
+(RESULTS.md round-2/round-3 timelines). The no-exceptions "prefix every
+CPU-only python with PALLAS_AXON_POOL_IPS=" rule lived only in process
+documentation (.claude/skills/verify/SKILL.md) and failed in round 3 — one
+unprefixed one-liner cost a 10+ hour TPU window.
+
+This module is the in-code guard (VERDICT r3 "next round" #2):
+
+* a **claim lockfile** (default `<repo>/.chip_claim.lock`) records which
+  process may talk to the chip.  `bench.py`, `scripts/tpu_validation.py` and
+  `scripts/learn_proof.py` acquire it before any backend init.
+* an **import-time guard** (`guard()`, called from `rt1_tpu/__init__`)
+  auto-enrolls any axon-enabled process that imports the framework: it
+  either takes the lock or — when a *different live* process holds it —
+  refuses loudly with the holder's identity, long before the process can
+  dial the relay and collide with the in-flight claim.
+* a **token umbrella** (`RT1_CHIP_CLAIM_TOKEN`) lets an owner's
+  subprocesses (claim probes, bench children) run under the parent's claim
+  instead of dead-locking against it.
+
+The prefix rule remains as a backstop for processes that never import
+`rt1_tpu` (see `.claude/skills/verify/SKILL.md`), but the catastrophic case
+— two framework processes claiming concurrently — is now refused by code.
+
+Stdlib-only on purpose: it must be importable before (and without) jax.
+
+The reference has no equivalent subsystem — its GPUs are process-local and
+a crashed client releases them with the process.  A tunneled, leased TPU
+chip makes claim lifetime a first-class failure domain, so the framework
+gets a first-class mechanism for it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import time
+import uuid
+
+LOCK_ENV = "RT1_CHIP_CLAIM_LOCK"
+TOKEN_ENV = "RT1_CHIP_CLAIM_TOKEN"
+DISABLE_ENV = "RT1_CHIP_GUARD_DISABLE"
+# Set by entrypoints that manage the claim lifecycle themselves (bench.py,
+# scripts/tpu_validation.py, scripts/learn_proof.py) BEFORE importing
+# rt1_tpu: the import-time guard then stays out of the way so their
+# explicit acquire() owns the claim (patient waits, probe transfer,
+# friendly exit codes). Without this, guard()'s import-time acquisition
+# would preempt the explicit one into a powerless umbrella claim.
+SELF_MANAGED_ENV = "RT1_CHIP_GUARD_SELF"
+
+# Lock holders are always python processes (the lock is written by this
+# module).  A recycled pid whose cmdline is not python is therefore stale.
+_HOLDER_CMD_MARKERS = (b"python", b"pytest")
+
+
+class ChipClaimHeld(RuntimeError):
+    """Another live process holds the chip claim lock."""
+
+
+def lock_path() -> str:
+    path = os.environ.get(LOCK_ENV)
+    if path:
+        return path
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(repo, ".chip_claim.lock")
+
+
+def axon_active() -> bool:
+    """Whether this process would dial the axon relay on jax backend init.
+
+    The CPU prefix (`PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu`) makes this
+    False; the production env (`PALLAS_AXON_POOL_IPS=127.0.0.1`,
+    `JAX_PLATFORMS=axon`) makes it True.
+    """
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return False
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    if not platforms:
+        # No explicit platform + a registered axon plugin: jax would pick
+        # the accelerator backend, i.e. dial.
+        return True
+    return "axon" in platforms or "tpu" in platforms
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        with open(f"/proc/{int(pid)}/cmdline", "rb") as f:
+            cmdline = f.read()
+    except (OSError, ValueError):
+        return False
+    if not cmdline.strip(b"\0"):
+        # Mid-exec (fork->exec window) or zombie: the pid exists but its
+        # cmdline is momentarily empty. Err on the side of "alive" — a
+        # false "dead" here green-lights the concurrent-claim collision
+        # this module exists to prevent, while a false "alive" merely
+        # waits/refuses until the state resolves.
+        return True
+    return any(m in cmdline for m in _HOLDER_CMD_MARKERS)
+
+
+def holder(path: str | None = None) -> dict | None:
+    """The current lock record, or None when unlocked/corrupt."""
+    path = path or lock_path()
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    return record if isinstance(record, dict) and "pid" in record else None
+
+
+class Claim:
+    """A held (or inherited) chip claim.  Context-manager; release() is
+    idempotent and only ever deletes a lockfile this claim owns."""
+
+    def __init__(self, path: str, token: str, owned: bool):
+        self.path = path
+        self.token = token
+        self.owned = owned
+        self._released = False
+
+    def release(self) -> None:
+        if self._released or not self.owned:
+            self._released = True
+            return
+        self._released = True
+        record = holder(self.path)
+        if record and record.get("token") == self.token:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def transfer(self, pid: int, tag: str) -> None:
+        """Hand the lock to `pid` (e.g. a dangling claim probe that must be
+        left to its own ~25-min client-side give-up rather than killed).
+        The lock then expires via the pid-liveness check when `pid` exits.
+        """
+        if not self.owned:
+            return  # an umbrella claim has nothing to hand over
+        _write_lock(self.path, pid=pid, tag=tag, token=self.token)
+        self.owned = False  # the dangling child owns it now; never unlink
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def _reap(path: str, observed: dict | None) -> bool:
+    """Atomically remove a lock we observed as stale/corrupt.
+
+    Blind `os.unlink(path)` is a TOCTOU: between our read and the unlink,
+    another process may have reaped the same stale lock and linked a fresh
+    valid one — the unlink would then destroy a live claim and let two
+    owners dial the chip. Rename-to-private-name is atomic (exactly one
+    reaper wins); the content check afterwards restores a lock that turned
+    out to be someone's fresh one.
+    """
+    victim = f"{path}.{os.getpid()}.reap"
+    try:
+        os.rename(path, victim)
+    except OSError:
+        return False  # someone else reaped or replaced it first; re-examine
+    try:
+        with open(victim) as f:
+            current = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        current = None
+    if current is None or current == observed:
+        try:
+            os.unlink(victim)
+        except OSError:
+            pass
+        return True
+    # Raced: we renamed a FRESH lock someone linked after our read. Put it
+    # back (link fails only if yet another lock appeared meanwhile — then
+    # nothing safe remains to do and the next acquire() sorts it out).
+    try:
+        os.link(victim, path)
+    except OSError:
+        pass
+    try:
+        os.unlink(victim)
+    except OSError:
+        pass
+    return False
+
+
+def _write_lock(path: str, *, pid: int, tag: str, token: str) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {"pid": pid, "tag": tag, "token": token, "created": time.time()},
+            f,
+        )
+    os.replace(tmp, path)
+
+
+def _held_message(record: dict, path: str) -> str:
+    age = time.time() - record.get("created", time.time())
+    return (
+        f"TPU chip claim is held by pid {record.get('pid')} "
+        f"(tag={record.get('tag')!r}, {age / 60:.1f} min old, lock={path}). "
+        f"Starting a second axon client now would collide with the "
+        f"in-flight claim and can wedge the chip for hours "
+        f"(RESULTS.md round-3 timeline). Wait for the holder to exit, run "
+        f"CPU-only (PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu), or — if the "
+        f"holder is provably not talking to the chip — remove the lock "
+        f"with: PALLAS_AXON_POOL_IPS= python -m rt1_tpu.chip_claim clear "
+        f"(the CPU prefix keeps the CLI itself outside the guard)"
+    )
+
+
+def acquire(tag: str, path: str | None = None, wait_s: float = 0.0,
+            poll_s: float = 10.0) -> Claim:
+    """Take the chip-claim lock (or join the parent's via the token env).
+
+    Raises ChipClaimHeld when a different live process holds it and it does
+    not free up within `wait_s`.  On success the claim token is exported to
+    `RT1_CHIP_CLAIM_TOKEN` so subprocesses inherit the umbrella, and an
+    atexit release is registered (SIGKILL'd owners are reaped by the
+    pid-liveness check on the next acquire).
+    """
+    path = path or lock_path()
+    my_token = os.environ.get(TOKEN_ENV)
+    deadline = time.monotonic() + wait_s
+    while True:
+        token = my_token or uuid.uuid4().hex
+        # Atomic create-with-content: write a private tmp, hard-link it into
+        # place (link fails iff the lock exists). A bare O_EXCL-create-then-
+        # write would expose an empty file that a concurrent acquirer reads
+        # as corrupt and unlinks — both processes then "own" the chip.
+        tmp = f"{path}.{os.getpid()}.acquire"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "pid": os.getpid(),
+                    "tag": tag,
+                    "token": token,
+                    "created": time.time(),
+                },
+                f,
+            )
+        try:
+            os.link(tmp, path)
+        except FileExistsError:
+            record = holder(path)
+            if record is None:
+                # Corrupt or vanished mid-read: reap (atomically) and retry.
+                if os.path.exists(path):
+                    _reap(path, None)
+                continue
+            if not _pid_alive(record.get("pid", -1)):
+                # Stale: holder died (possibly SIGKILL'd — atexit skipped).
+                # Checked BEFORE the token umbrella: a child inheriting the
+                # token of a dead parent must not join a defunct umbrella
+                # that a concurrent fresh acquirer is about to reap.
+                _reap(path, record)
+                continue
+            if my_token and record.get("token") == my_token:
+                # Live parent holds the lock; run under its umbrella.
+                return Claim(path, my_token, owned=False)
+            if time.monotonic() < deadline:
+                time.sleep(poll_s)
+                continue
+            raise ChipClaimHeld(_held_message(record, path))
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        claim = Claim(path, token, owned=True)
+        os.environ[TOKEN_ENV] = token
+        atexit.register(claim.release)
+        return claim
+
+
+_GUARD_CLAIM: Claim | None = None
+
+
+def guard() -> None:
+    """Import-time enrollment, called from `rt1_tpu/__init__`.
+
+    CPU-pinned processes pass through untouched.  An axon-enabled process
+    either takes the claim lock (becoming the one allowed claimant) or —
+    when a different live process holds it — gets a loud refusal *before*
+    any backend init can dial the relay.  `RT1_CHIP_GUARD_DISABLE=1` is the
+    escape hatch.
+    """
+    global _GUARD_CLAIM
+    if os.environ.get(DISABLE_ENV) == "1":
+        return
+    if os.environ.get(SELF_MANAGED_ENV) == "1":
+        # bench/tpu_validation/learn_proof manage the claim themselves;
+        # an import-time acquisition here would demote their explicit
+        # acquire() to a powerless umbrella (no transfer, no patience).
+        return
+    if not axon_active():
+        return
+    if _GUARD_CLAIM is not None:
+        return
+    prog = os.path.basename(sys.argv[0]) if sys.argv and sys.argv[0] else "python"
+    _GUARD_CLAIM = acquire(f"import:{prog}:{os.getpid()}")
+
+
+def main(argv=None) -> int:
+    """`python -m rt1_tpu.chip_claim {status|clear}` operator CLI.
+
+    Run it CPU-prefixed (`PALLAS_AXON_POOL_IPS= python -m ...`): unprefixed
+    in the axon env, importing the package runs guard(), which would refuse
+    against a live holder before this function is reached. Against a STALE
+    lock the guard auto-acquires — released here so status/clear report the
+    external state, not this CLI process itself.
+    """
+    global _GUARD_CLAIM
+    if _GUARD_CLAIM is not None:
+        _GUARD_CLAIM.release()
+        _GUARD_CLAIM = None
+    argv = sys.argv[1:] if argv is None else argv
+    cmd = argv[0] if argv else "status"
+    path = lock_path()
+    record = holder(path)
+    if cmd == "status":
+        if record is None:
+            print(json.dumps({"locked": False, "path": path}))
+        else:
+            print(
+                json.dumps(
+                    {
+                        "locked": True,
+                        "path": path,
+                        "holder": record,
+                        "holder_alive": _pid_alive(record.get("pid", -1)),
+                    }
+                )
+            )
+        return 0
+    if cmd == "clear":
+        if record is not None and _pid_alive(record.get("pid", -1)):
+            print(
+                f"refusing to clear: holder pid {record['pid']} is alive "
+                f"({record.get('tag')!r}). Kill/stop it first (SIGINT, "
+                f"never SIGKILL mid-claim), or pass its death to the "
+                f"stale-reaper by just retrying your command.",
+                file=sys.stderr,
+            )
+            return 1
+        if record is not None:
+            _reap(path, record)
+        else:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+        print(json.dumps({"cleared": True, "path": path}))
+        return 0
+    print(f"unknown command {cmd!r}; use: status | clear", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    # `python -m` executes this file as a distinct `__main__` module while
+    # the package __init__'s guard() ran in the canonical
+    # `rt1_tpu.chip_claim` instance — dispatch there so main() can see
+    # (and release) the guard's claim.
+    from rt1_tpu import chip_claim as _canonical
+
+    raise SystemExit(_canonical.main())

@@ -171,11 +171,21 @@ def evaluate_policy(
     embedder="hash",
     write_videos=False,
     env_kwargs=None,
+    video_tag="",
 ):
-    """Full protocol over reward families; returns {reward: successes}."""
+    """Full protocol over reward families; returns {reward: successes}.
+
+    `video_tag` namespaces the video directory per policy identity
+    (baseline name / checkpoint step): filenames alone are
+    {reward}_{ep}_{success|failure}, so two different policies evaluated
+    against the same workdir would otherwise interleave — and overwrite —
+    each other's outcome videos (ADVICE r3).
+    """
     video_dir = None
     if write_videos and workdir is not None:
-        video_dir = os.path.join(workdir, "videos")
+        video_dir = os.path.join(
+            workdir, f"videos_{video_tag}" if video_tag else "videos"
+        )
         os.makedirs(video_dir, exist_ok=True)
 
     results = collections.defaultdict(int)

@@ -144,6 +144,9 @@ def main(argv):
         embedder=FLAGS.embedder,
         write_videos=FLAGS.videos,
         env_kwargs=env_kwargs,
+        # Namespace videos by policy identity: a --baseline oracle run must
+        # not overwrite a trained-policy eval's videos in the same workdir.
+        video_tag=FLAGS.baseline if FLAGS.baseline else f"ckpt{step}",
     )
     results["checkpoint_step"] = step
     print(json.dumps(results))

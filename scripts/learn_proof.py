@@ -30,6 +30,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Before any rt1_tpu import: train/eval claim the chip explicitly in main()
+# (rt1_tpu/chip_claim.py::SELF_MANAGED_ENV keeps the import-time guard from
+# preempting that acquire into a powerless umbrella).
+os.environ.setdefault("RT1_CHIP_GUARD_SELF", "1")
+
 from absl import app, flags
 
 FLAGS = flags.FLAGS
@@ -38,7 +43,20 @@ flags.DEFINE_integer("episodes", 800, "Successful episodes to collect.")
 flags.DEFINE_integer("workers", 12, "Parallel collection processes.")
 flags.DEFINE_integer("num_steps", 20000, "Training steps.")
 flags.DEFINE_integer("eval_episodes", 20, "Closed-loop episodes per policy.")
-flags.DEFINE_string("stage", "all", "all | collect | train | eval")
+flags.DEFINE_string("stage", "all", "all | collect | train | eval | dagger")
+flags.DEFINE_integer(
+    "dagger_rounds", 3,
+    "DAgger iterations: rollout-with-oracle-relabeling -> aggregate -> "
+    "extend training (rt1_tpu/data/dagger.py; VERDICT r3 #4).")
+flags.DEFINE_integer(
+    "dagger_episodes", 40, "On-policy episodes aggregated per DAgger round.")
+flags.DEFINE_float(
+    "dagger_beta", 0.0,
+    "Probability of executing the ORACLE's action instead of the policy's "
+    "during DAgger rollouts (beta-mixing; 0 = pure on-policy DAgger).")
+flags.DEFINE_integer(
+    "dagger_extra_steps", 5000,
+    "Training-step extension after each DAgger aggregation round.")
 flags.DEFINE_float(
     "exec_noise_std", 0.0,
     "DART execution-noise std at collection: executed action = oracle "
@@ -73,6 +91,12 @@ flags.DEFINE_enum(
     "dtype", "bfloat16", ["bfloat16", "float32"],
     "Model compute dtype. bfloat16 on TPU; float32 is ~1.4x faster on the "
     "CPU fallback (oneDNN emulates bf16).")
+flags.DEFINE_bool(
+    "constant_lr", False,
+    "Disable the MultiStepLR decay (milestones pushed past the horizon): "
+    "the round-4 recipe trains the flagship DART arm >=50k steps at FULL "
+    "LR — the round-3 plateau diagnosis showed the decay freezes the "
+    "policy before the token CE escapes the marginal (RESULTS.md).")
 flags.DEFINE_string(
     "run_tag", "r03",
     "Label stamped into the self-archived artifact filenames; pass a fresh "
@@ -80,11 +104,14 @@ flags.DEFINE_string(
 
 REWARD = "block2block"
 EVAL_SEED = 10_000  # disjoint from collection worker seeds (0..workers)
+DAGGER_SEED = 30_000  # disjoint from eval (10k) and diagnostics (20k) seeds
 
 
-def get_train_config(data_dir, num_steps):
+def get_train_config(data_dir, num_steps, constant_lr=None):
     from rt1_tpu.train.configs import language_table
 
+    if constant_lr is None:
+        constant_lr = FLAGS.constant_lr
     config = language_table.get_config()
     config.model.image_tokenizer = FLAGS.image_tokenizer
     config.model.time_sequence_length = FLAGS.seq_len
@@ -100,7 +127,10 @@ def get_train_config(data_dir, num_steps):
     # the run, reference schedule shape (distribute_train.py:283-287).
     # max(1, ...): steps_per_epoch=0 would collapse every milestone to
     # boundary 0 and train the whole run at the final decayed LR.
-    config.steps_per_epoch = max(1, num_steps // 100)
+    # --constant_lr pushes every boundary past the horizon instead.
+    config.steps_per_epoch = (
+        num_steps * 100 if constant_lr else max(1, num_steps // 100)
+    )
     config.checkpoint_every_steps = FLAGS.checkpoint_every
     config.keep_period = 10000
     config.log_every_steps = 50
@@ -270,6 +300,29 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACTS_DIR = os.path.join(REPO_ROOT, "artifacts")
 
 
+def corpus_accounting(data_dir, manifest):
+    """Corpus identity from the manifest + files on disk — NEVER the flags.
+
+    Round 3's DART artifact claimed `episodes_collected: 800` (the
+    requested `--episodes`) against an actual 125-episode corpus
+    (VERDICT r3 weak #3). Returns (episodes_collected, episodes_by_split).
+    """
+    split_counts = {
+        name: sum(
+            1 for f in os.listdir(os.path.join(data_dir, name))
+            if f.endswith(".npz")
+        )
+        for name in ("train", "val", "test")
+        if os.path.isdir(os.path.join(data_dir, name))
+    }
+    disk_total = sum(split_counts.values())
+    episodes = (
+        manifest.get("episodes", disk_total) if manifest is not None
+        else disk_total
+    )
+    return episodes, split_counts
+
+
 def _archive(src, dest_name):
     """Copy one proof file into the repo's artifacts/ (committable).
 
@@ -348,6 +401,96 @@ def _plot_curves(curves, path):
     fig.savefig(path, dpi=120)
 
 
+def stage_dagger(data_dir, train_dir):
+    """DAgger loop: on-policy rollouts relabeled by the oracle, aggregated
+    into the corpus, training extended — repeated `dagger_rounds` times.
+
+    The scale-independent attack on the round-3 failure mode (policy
+    leaves the demo distribution once, then collapses to the marginal):
+    each round adds labels exactly on the states the current policy visits.
+    Per-round rollout success counts double as a closed-loop trajectory of
+    the policy across rounds; the artifact is archived like the eval
+    proofs. Training extensions run at full LR (no milestone decay): every
+    aggregation changes the data distribution, so the reference schedule's
+    late-run decay would freeze the policy precisely when its corpus
+    shifts.
+    """
+    import numpy as np
+
+    from rt1_tpu.data.collect import check_embedder_compatibility
+    from rt1_tpu.data.dagger import (
+        DAGGER_HISTORY_KEYS,
+        append_episodes_to_corpus,
+        collect_dagger_episode,
+    )
+    from rt1_tpu.envs import blocks
+    from rt1_tpu.envs.oracles import RRTPushOracle
+    from rt1_tpu.eval.evaluate import build_eval_env
+    from rt1_tpu.train.train import train_and_evaluate
+
+    _check_train_meta(train_dir, "dagger", EVAL_META_KEYS)
+    check_embedder_compatibility(data_dir, FLAGS.embedder, context="dagger")
+    history = []
+    for rnd in range(FLAGS.dagger_rounds):
+        latest = _latest_step(os.path.join(train_dir, "checkpoints"))
+        if latest is None:
+            raise RuntimeError(
+                "dagger: no checkpoint to roll out; run --stage train first"
+            )
+        policy = _restore_policy(train_dir, data_dir)
+        env = build_eval_env(
+            reward_name=REWARD,
+            block_mode=blocks.BlockMode(FLAGS.block_mode),
+            seed=DAGGER_SEED + 1000 * rnd,
+            embedder=FLAGS.embedder,
+            target_height=FLAGS.height,
+            target_width=FLAGS.width,
+            sequence_length=FLAGS.seq_len,
+            history_keys=DAGGER_HISTORY_KEYS,
+        )
+        oracle = RRTPushOracle(env, use_ee_planner=True)
+        rng = np.random.default_rng(DAGGER_SEED + rnd)
+        episodes, successes, attempts = [], 0, 0
+        while (
+            len(episodes) < FLAGS.dagger_episodes
+            and attempts < 5 * FLAGS.dagger_episodes
+        ):
+            attempts += 1
+            ep, success = collect_dagger_episode(
+                env, policy, oracle,
+                beta=FLAGS.dagger_beta, rng=rng,
+            )
+            if ep is None:
+                continue  # init had no collision-free plan; re-randomized
+            episodes.append(ep)
+            successes += int(success)
+        total = append_episodes_to_corpus(data_dir, episodes)
+        entry = {
+            "round": rnd,
+            "from_checkpoint": latest,
+            "rollout_episodes": len(episodes),
+            "rollout_successes": successes,
+            "corpus_train_episodes_after": total,
+        }
+        history.append(entry)
+        print(f"dagger round {rnd}: {entry}")
+
+        # Full LR throughout (constant_lr): every aggregation shifts the
+        # data distribution, so the reference schedule's late-run decay
+        # would freeze the policy precisely when its corpus changes.
+        target = latest + FLAGS.dagger_extra_steps
+        config = get_train_config(data_dir, target, constant_lr=True)
+        train_and_evaluate(config, train_dir)
+
+    summary_path = os.path.join(FLAGS.workdir, "dagger_rounds.json")
+    with open(summary_path + ".tmp", "w") as f:
+        json.dump({"beta": FLAGS.dagger_beta, "rounds": history}, f, indent=2)
+    os.replace(summary_path + ".tmp", summary_path)
+    tag = os.path.basename(os.path.normpath(FLAGS.workdir))
+    _archive(summary_path, f"{tag}_dagger_rounds_{FLAGS.run_tag}.json")
+    return history
+
+
 def stage_eval(train_dir, data_dir):
     from rt1_tpu.data.collect import check_embedder_compatibility, read_manifest
 
@@ -385,11 +528,16 @@ def stage_eval(train_dir, data_dir):
     curves = _read_curves(train_dir)
     _plot_curves(curves, os.path.join(FLAGS.workdir, "loss_curve.png"))
 
+    episodes_collected, split_counts = corpus_accounting(data_dir, manifest)
     summary = {
         "reward": REWARD,
         "block_mode": FLAGS.block_mode,
-        "embedder": FLAGS.embedder,
-        "episodes_collected": FLAGS.episodes,
+        "embedder": (
+            manifest.get("embedder", FLAGS.embedder)
+            if manifest is not None else FLAGS.embedder
+        ),
+        "episodes_collected": episodes_collected,
+        "episodes_by_split": split_counts,
         "exec_noise_std": corpus_noise,
         "train_steps": FLAGS.num_steps,
         "seq_len": FLAGS.seq_len,
@@ -411,6 +559,17 @@ def stage_eval(train_dir, data_dir):
         "final_eval_loss":
             curves["eval_loss"][-1][1] if curves["eval_loss"] else None,
     }
+    # Success is defined against the measured expert ceiling of the SAME
+    # protocol (VERDICT r3 weak #7), not an absolute rate: the RRT oracle
+    # itself solves only ~half of oracle-validated inits within the 80-step
+    # budget, so "trained >= half the oracle's rate" is the honest bar.
+    oracle_n = summary["oracle_successes"]
+    summary["success_criterion"] = (
+        "trained_successes >= max(1, oracle_successes // 2)"
+    )
+    summary["criterion_met"] = bool(
+        summary["trained_successes"] >= max(1, oracle_n // 2)
+    )
     # tmp+rename: a mid-write kill must not leave a truncated file that the
     # pipeline's completeness check could mistake for a finished arm.
     proof_path = os.path.join(FLAGS.workdir, "learn_proof.json")
@@ -434,12 +593,22 @@ def stage_eval(train_dir, data_dir):
 
 def main(argv):
     del argv
+    from rt1_tpu import chip_claim
+
+    # Train/eval may claim the attached chip; take the claim lock up front
+    # (the rt1_tpu import's guard already did when axon is active — this
+    # documents it and fails loudly under --stage collect misuse too).
+    # The pipeline retries a held claim after its cooldown.
+    if FLAGS.stage != "collect" and chip_claim.axon_active():
+        chip_claim.acquire(f"learn_proof:{FLAGS.stage}")
     data_dir = os.path.join(FLAGS.workdir, "data")
     train_dir = os.path.join(FLAGS.workdir, "train")
     if FLAGS.stage in ("all", "collect"):
         data_dir = stage_collect()
     if FLAGS.stage in ("all", "train"):
         train_dir = stage_train(data_dir)
+    if FLAGS.stage == "dagger":
+        stage_dagger(data_dir, train_dir)
     if FLAGS.stage in ("all", "eval"):
         stage_eval(train_dir, data_dir)
 

@@ -38,7 +38,9 @@ import learn_proof  # noqa: E402  (registers its flags: --workdir etc.)
 FLAGS = flags.FLAGS
 # learn_proof already owns --episodes (collection count); diagnostics get
 # their own names.
-flags.DEFINE_integer("diag_episodes", 10, "Diagnostic episodes.")
+# >=20 by default: the round-3 6-episode diagnostics had enough variance to
+# fake a regression at ck15000 (VERDICT r3 weak #4).
+flags.DEFINE_integer("diag_episodes", 20, "Diagnostic episodes.")
 flags.DEFINE_integer("max_steps", 80, "Step budget per episode.")
 flags.DEFINE_integer("diag_seed", 20_000, "Env seed (disjoint from train/eval).")
 flags.DEFINE_string("out", "", "Output JSON (default: <workdir>/diagnostics.json)")

@@ -41,15 +41,17 @@ log "corpus ready: $(tr -d '\n' < "$CORPUS/data/manifest.json")"
 # (~200 eps/h/core), so it overlaps the bench matrix and clean arms.
 DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
 DART_NOISE=0.005
-DART_PIDFILE="$DART_CORPUS/collector.pid"
 collector_alive() {
-  # pidfile first; pgrep fallback covers setsid re-forking (pidfile then
-  # holds the short-lived wrapper, not the collector).
-  { [ -f "$DART_PIDFILE" ] && kill -0 "$(cat "$DART_PIDFILE")" 2>/dev/null; } ||
-    pgrep -f "learn_proof.py --workdir $DART_CORPUS --stage collect" > /dev/null
+  # pgrep on the exact collect invocation only. The previous pidfile check
+  # stored the short-lived setsid wrapper's PID; after the wrapper exited,
+  # PID reuse could falsely report the collector alive and strand the DART
+  # arm for the full wait (ADVICE r3). pgrep matches live cmdlines, which
+  # cannot be stale. (Spawn workers have a different cmdline — see
+  # SKILL.md — but the parent learn_proof.py stays alive while they run.)
+  pgrep -f "learn_proof.py --workdir $DART_CORPUS --stage collect" > /dev/null
 }
 if [ ! -f "$DART_CORPUS/data/manifest.json" ] && ! collector_alive; then
-  # pidfile guard: a pipeline relaunch while a prior detached collector is
+  # liveness guard: a pipeline relaunch while a prior detached collector is
   # still writing must NOT spawn a second writer into the same data dir.
   log "launching DART corpus collection (400 eps, noise $DART_NOISE) in background"
   mkdir -p "$DART_CORPUS"
@@ -57,7 +59,6 @@ if [ ! -f "$DART_CORPUS/data/manifest.json" ] && ! collector_alive; then
     python scripts/learn_proof.py --workdir "$DART_CORPUS" --stage collect \
     --episodes 400 --workers 2 --exec_noise_std "$DART_NOISE" \
     >> artifacts/collect_dart_flagship.log 2>&1 < /dev/null &
-  echo "$!" > "$DART_PIDFILE"
 fi
 
 # ---- stage 1: full bench matrix (train/e2e/mfu/infer dense+pallas/ring) ----

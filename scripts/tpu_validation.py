@@ -25,6 +25,16 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# Before any rt1_tpu import: this driver manages the claim itself (one
+# claim for the whole matrix, exported to every bench child via the token
+# env). See rt1_tpu/chip_claim.py::SELF_MANAGED_ENV.
+os.environ.setdefault("RT1_CHIP_GUARD_SELF", "1")
+
+# The run's owned claim (set in main); wait_for_chip hands it to a
+# dangling probe child when aborting rather than leaving the lock to be
+# released while the child still dials.
+_CLAIM = None
+
 
 def run_bench(mode, extra=(), timeout=3600):
     """Run bench.py in a subprocess; return (headline dict, stderr detail).
@@ -57,7 +67,11 @@ def run_bench(mode, extra=(), timeout=3600):
     except subprocess.TimeoutExpired:
         proc.send_signal(signal.SIGINT)
         try:
-            proc.communicate(timeout=60)
+            # SIGINT does not land while the client sits in the blocking
+            # claim wait, so give the child long enough to reach the axon
+            # client's own ~25-min give-up before even considering a kill —
+            # a SIGKILL mid-claim re-extends the wedge for everyone after.
+            proc.communicate(timeout=1800)
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.communicate()
@@ -86,8 +100,17 @@ def run_bench(mode, extra=(), timeout=3600):
     return headline, detail
 
 
-def ring_forward_on_chip():
-    """Exact ring == dense on the real device (1-device degenerate ring)."""
+def ring_forward_on_chip(results):
+    """Exact ring == dense on the real device (1-device degenerate ring).
+
+    Also records the device inventory INTO `results` as soon as backend
+    init succeeds — before the ring math, so a ring failure can't lose it.
+    (The earlier separate `subprocess.run(..., timeout=180)` inventory
+    probe SIGKILL'd `jax.devices()` mid-claim on a wedged chip,
+    re-extending the wedge on every pipeline attempt — the exact hazard
+    this script exists to avoid; listing devices here costs nothing since
+    the parent claims for the ring test anyway.)
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,6 +121,7 @@ def ring_forward_on_chip():
         ring_attention,
     )
 
+    results["devices"] = [str(d) for d in jax.devices()]
     rng = np.random.default_rng(2)
     b, s, h, d = 2, 64, 4, 64
     q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
@@ -112,7 +136,7 @@ def ring_forward_on_chip():
     return {"max_abs_err_vs_dense": err, "ok": err < 1e-4}
 
 
-def wait_for_chip(max_probes=8, probe_timeout=2100, sleep_s=120):
+def wait_for_chip(max_probes=None, probe_timeout=2100, sleep_s=120):
     """Block until the axon chip is claimable (probe in a subprocess).
 
     The probe timeout must EXCEED the wedge's own client-side give-up time
@@ -123,22 +147,45 @@ def wait_for_chip(max_probes=8, probe_timeout=2100, sleep_s=120):
     """
     import time as _time
 
+    if max_probes is None:
+        # Round-4 wedge hypothesis: continuous patient probing may itself
+        # sustain the server-side wedge (round 3: >10 h of clean 25-min
+        # probes never recovered; only quiet periods + host resets did).
+        # The pipeline dials this down to 1 probe per invocation and
+        # spaces invocations by an hour instead.
+        max_probes = int(os.environ.get("RT1_WAIT_MAX_PROBES", "8"))
     for i in range(max_probes):
+        # Popen + wait, NEVER kill: subprocess.run(timeout=...) SIGKILLs the
+        # probe child mid-claim on expiry, re-extending the wedge (the same
+        # hazard bench._chip_probe was redesigned around). The 35-min budget
+        # normally exceeds the client's ~25-min give-up; if the client sits
+        # in one of its observed multi-hour silent waits instead, grant one
+        # long grace, then hand the claim lock to the dangling child and
+        # abort the run — continuing to spawn bench children would dial
+        # concurrently with it.
+        probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=REPO,
+            start_new_session=True,
+        )
         try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=probe_timeout,
-                capture_output=True,
-                cwd=REPO,
-            )
-            if probe.returncode == 0:
-                return True
+            rc = probe.wait(timeout=probe_timeout)
         except subprocess.TimeoutExpired:
-            # Should not happen with the 35-min budget; if it does, stop
-            # probing entirely rather than keep feeding the wedge.
-            print("chip probe exceeded even the wedge give-up time; "
-                  "stopping probes", flush=True)
-            return False
+            print("chip probe exceeded the wedge give-up time; granting "
+                  "one 60-min grace (never killing mid-claim)", flush=True)
+            try:
+                rc = probe.wait(timeout=3600)
+            except subprocess.TimeoutExpired:
+                print("chip probe still claim-waiting after grace; "
+                      "transferring the claim lock to it and aborting "
+                      "this validation run", flush=True)
+                if _CLAIM is not None:
+                    _CLAIM.transfer(probe.pid, tag="dangling-wait-probe")
+                os._exit(4)
+        if rc == 0:
+            return True
         print(f"chip probe {i + 1}: not claimable yet", flush=True)
         _time.sleep(sleep_s)
     return False
@@ -154,9 +201,22 @@ def main():
     # initialize the backend). The parent must not *initialize* jax (e.g.
     # jax.devices()) before the bench subprocesses: backend init claims the
     # chip for this process's whole lifetime and contends with every child.
+    from rt1_tpu import chip_claim
     from rt1_tpu.compilation_cache import enable_persistent_cache
 
     enable_persistent_cache()
+
+    # One validation run = one chip claim, for the whole matrix (the
+    # module-top RT1_CHIP_GUARD_SELF marker keeps the import-time guard
+    # from preempting this acquire). Children — bench modes, wait_for_chip
+    # probes — inherit the token umbrella via the environment.
+    if chip_claim.axon_active():
+        global _CLAIM
+        try:
+            _CLAIM = chip_claim.acquire("tpu_validation")
+        except chip_claim.ChipClaimHeld as e:
+            print(f"tpu_validation: {e}", file=sys.stderr)
+            return 3
     # `status` rides inside results through every checkpoint (flipped to
     # "done" at the end), so an in-progress file is always distinguishable
     # from a completed one — not just before the first checkpoint.
@@ -207,21 +267,12 @@ def main():
             if chip_related(headline):
                 wait_for_chip()
 
-    # Device inventory via a short-lived subprocess, independent of the ring
-    # test's outcome (and releasing its claim immediately).
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, json; print(json.dumps([str(d) for d in jax.devices()]))"],
-            timeout=180, capture_output=True, text=True, cwd=REPO,
-        )
-        results["devices"] = json.loads(probe.stdout.strip().splitlines()[-1])
+        results["ring_on_chip"] = ring_forward_on_chip(results)
     except Exception as e:
-        results["devices"] = f"probe failed: {e!r}"[:200]
-
-    try:
-        results["ring_on_chip"] = ring_forward_on_chip()
-    except Exception as e:
+        # Backend init may have succeeded before the failure, in which case
+        # `devices` is already recorded; otherwise say why it's absent.
+        results.setdefault("devices", f"unavailable (ring init failed: {e!r})"[:200])
         results["ring_on_chip"] = f"FAILED: {e!r}"[:500]
     print("ring ->", results["ring_on_chip"], flush=True)
 
@@ -231,4 +282,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -161,12 +161,14 @@ def test_oracle_eval_policy_protocol():
 
 def test_env_bench_mode(capsys):
     """bench.py --mode env: host-only simulator throughput, no accelerator
-    claim, one parseable JSON headline."""
+    claim, one parseable JSON headline — and --steps is honored (ADVICE
+    r3: it used to be silently ignored in env mode)."""
+    import argparse
     import json
 
     import bench
 
-    bench.env_bench(None, n_steps=20)
+    bench.env_bench(argparse.Namespace(steps=1))
     headline = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert headline["metric"] == "env_control_steps_per_sec"
     assert headline["value"] > 0

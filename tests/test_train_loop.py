@@ -286,6 +286,32 @@ def test_collect_dart_noise_records_clean_labels(tmp_path):
         assert json.load(f)["exec_noise_std"] == 0.01
 
 
+def test_learn_proof_corpus_accounting_from_manifest(tmp_path):
+    """learn_proof.json's corpus fields come from the manifest + disk, never
+    the --episodes flag (VERDICT r3 weak #3: the round-3 DART artifact
+    self-reported a 6.6x wrong corpus size)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    from learn_proof import corpus_accounting
+
+    data_dir = tmp_path / "data"
+    for split, n in (("train", 5), ("val", 2), ("test", 1)):
+        (data_dir / split).mkdir(parents=True)
+        for i in range(n):
+            (data_dir / split / f"episode_{i}.npz").write_bytes(b"x")
+        (data_dir / split / "not_an_episode.txt").write_bytes(b"x")
+
+    # Manifest present: its total wins (it's the collection-time truth).
+    episodes, splits = corpus_accounting(str(data_dir), {"episodes": 8})
+    assert episodes == 8
+    assert splits == {"train": 5, "val": 2, "test": 1}
+    # Pre-manifest corpus: fall back to counting files.
+    episodes, splits = corpus_accounting(str(data_dir), None)
+    assert episodes == 8
+    assert splits == {"train": 5, "val": 2, "test": 1}
+
+
 @pytest.mark.slow
 def test_collect_lifecycle(tmp_path):
     """collect -> real-data train: the hermetic data-generation path."""
