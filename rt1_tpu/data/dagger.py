@@ -1,0 +1,139 @@
+"""DAgger corrective relabeling: on-policy states, oracle labels.
+
+Round-3 measured mechanism of the closed-loop 0/20s: a BC policy trained on
+oracle demos leaves the demo state distribution after one imperfect action
+and collapses to the marginal action (RESULTS.md, `artifacts/
+cpu_t1_diag_ck7500.json` — action std 0.0009, oracle cosine −0.73, zero
+block progress). DART (execution noise at collection) covers *near-demo*
+states; DAgger (Ross et al. 2011) covers the states the TRAINED policy
+actually visits: roll the policy out, have the scripted RRT oracle label
+every visited state with its corrective action, aggregate those episodes
+into the corpus, retrain, iterate.
+
+The reference has no counterpart — its corpus is fixed pre-recorded human
+teleop (`/root/reference/rlds_np_convert.py`), which carries off-
+distribution recovery coverage naturally and cannot be extended. Hermetic
+in-framework data generation (`rt1_tpu/data/collect.py`) is what makes
+iterative corrective collection possible here.
+
+Episode format matches `collect_episode` exactly (native-resolution uint8
+rgb, per-step instruction embedding, clean oracle labels), so aggregated
+corpora stay loadable by the standard pipeline with no special casing.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from rt1_tpu.data.collect import read_manifest, write_manifest
+from rt1_tpu.data.episodes import encode_instruction_text, save_episode
+
+# Policies see the standard eval observation; the collector additionally
+# needs the native-resolution frame, so the env must be built with this
+# history-key set (extra keys are ignored by RT1EvalPolicy.action).
+DAGGER_HISTORY_KEYS = (
+    "rgb", "rgb_sequence", "natural_language_embedding",
+    "effector_translation", "effector_target_translation",
+)
+
+
+def collect_dagger_episode(
+    env,
+    policy,
+    oracle,
+    max_steps=80,
+    beta=0.0,
+    rng=None,
+    image_hw=None,
+):
+    """One on-policy rollout with per-step oracle relabeling.
+
+    `env` is the wrapped eval env (`build_eval_env`) whose `history_keys`
+    include `"rgb"` (see DAGGER_HISTORY_KEYS). The EXECUTED action is the
+    policy's (or, with probability `beta`, the oracle's — the DAgger
+    beta-mixing knob); the RECORDED label is always the oracle's corrective
+    action for the actually-visited state. Unlike demonstration collection,
+    unsuccessful episodes are KEPT: they are exactly the off-distribution
+    coverage this exists to gather.
+
+    Returns (episode dict | None, succeeded). None = no collision-free
+    plan existed for the initial state (init invalid, same as collection).
+    """
+    if beta and rng is None:
+        raise ValueError("beta > 0 requires an rng")
+    import cv2
+
+    obs = env.reset()
+    policy.reset()
+    oracle.reset()
+    if not oracle.get_plan(env.compute_state()):
+        return None, False
+
+    steps = {"action": [], "is_first": [], "is_terminal": [], "rgb": [],
+             "instruction": []}
+    done = False
+    t = 0
+    while not done and t < max_steps:
+        label = np.asarray(
+            oracle.action(env.compute_state()), np.float32
+        )
+        exec_action = label
+        if not (beta and rng.random() < beta):
+            exec_action = np.asarray(policy.action(obs), np.float32)
+        rgb = np.asarray(obs["rgb"][-1])  # native uint8 frame
+        if image_hw is not None:
+            rgb = cv2.resize(
+                rgb, (image_hw[1], image_hw[0]),
+                interpolation=cv2.INTER_LINEAR,
+            )
+        steps["action"].append(label)
+        steps["is_first"].append(t == 0)
+        steps["rgb"].append(rgb.astype(np.uint8))
+        steps["instruction"].append(
+            np.asarray(obs["natural_language_embedding"][-1], np.float32)
+        )
+        obs, _, done, _ = env.step(exec_action)
+        steps["is_terminal"].append(bool(done))
+        t += 1
+    # Horizon exhaustion still ends the stored episode: the windowing
+    # pipeline treats the last step as the episode boundary either way.
+    steps["is_terminal"][-1] = True
+    episode = {k: np.stack(v) for k, v in steps.items()}
+    episode["instruction_text"] = encode_instruction_text(env.instruction_str)
+    return episode, bool(env.succeeded)
+
+
+def append_episodes_to_corpus(data_dir, episodes, split="train"):
+    """Aggregate DAgger episodes into an existing corpus split.
+
+    Continues the split's episode numbering and updates the manifest's
+    total + a `dagger_episodes` counter, so `learn_proof.json`'s
+    manifest-sourced accounting (VERDICT r3 weak #3) stays truthful after
+    aggregation. The embedder/reward/block_mode stamps are left untouched:
+    the caller must collect with the corpus' own settings (enforced at
+    collection time by building the env from the manifest's fields).
+    """
+    split_dir = os.path.join(data_dir, split)
+    os.makedirs(split_dir, exist_ok=True)
+    existing = sum(
+        1 for f in os.listdir(split_dir)
+        if f.startswith("episode_") and f.endswith(".npz")
+    )
+    for i, episode in enumerate(episodes):
+        save_episode(
+            os.path.join(split_dir, f"episode_{existing + i}.npz"), episode
+        )
+    manifest = read_manifest(data_dir)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{data_dir} has no manifest.json — aggregate only into "
+            f"corpora produced by rt1_tpu.data.collect"
+        )
+    manifest["episodes"] = manifest.get("episodes", 0) + len(episodes)
+    manifest["dagger_episodes"] = (
+        manifest.get("dagger_episodes", 0) + len(episodes)
+    )
+    write_manifest(data_dir, **manifest)
+    return existing + len(episodes)
