@@ -217,8 +217,15 @@ def write_manifest(data_dir, **fields):
     emb = fields.get("embedder")
     if emb is not None and not isinstance(emb, str):
         fields["embedder"] = getattr(emb, "name", str(emb))
-    with open(os.path.join(data_dir, "manifest.json"), "w") as f:
+    # pid-unique tmp + rename: atomic for readers, an update never
+    # truncates a shared inode (hardlink-copied corpora: cp -al seeding,
+    # DAgger aggregation), and concurrent writers can't interleave inside
+    # one shared tmp file.
+    path = os.path.join(data_dir, "manifest.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         json.dump(fields, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
     return fields
 
 

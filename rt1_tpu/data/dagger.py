@@ -97,9 +97,12 @@ def collect_dagger_episode(
         obs, _, done, _ = env.step(exec_action)
         steps["is_terminal"].append(bool(done))
         t += 1
-    # Horizon exhaustion still ends the stored episode: the windowing
-    # pipeline treats the last step as the episode boundary either way.
-    steps["is_terminal"][-1] = True
+    # is_terminal is recorded HONESTLY: it becomes the terminate_episode
+    # action-token label downstream (data/pipeline.py), and the oracle
+    # would keep acting in a horizon-exhausted mid-task state — forcing a
+    # terminal flag there would teach the policy to emit terminate=1 at
+    # step 80 of every failed rollout. Windowing needs no end marker (it
+    # slices per-episode arrays), so an all-False episode is valid.
     episode = {k: np.stack(v) for k, v in steps.items()}
     episode["instruction_text"] = encode_instruction_text(env.instruction_str)
     return episode, bool(env.succeeded)
@@ -111,9 +114,10 @@ def append_episodes_to_corpus(data_dir, episodes, split="train"):
     Continues the split's episode numbering and updates the manifest's
     total + a `dagger_episodes` counter, so `learn_proof.json`'s
     manifest-sourced accounting (VERDICT r3 weak #3) stays truthful after
-    aggregation. The embedder/reward/block_mode stamps are left untouched:
-    the caller must collect with the corpus' own settings (enforced at
-    collection time by building the env from the manifest's fields).
+    aggregation. The embedder/reward/block_mode stamps are left untouched —
+    callers must roll out under the corpus' own settings
+    (`scripts/learn_proof.py::stage_dagger` validates its flags against
+    the manifest before collecting).
     """
     split_dir = os.path.join(data_dir, split)
     os.makedirs(split_dir, exist_ok=True)

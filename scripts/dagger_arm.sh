@@ -1,0 +1,31 @@
+#!/bin/bash
+# DAgger CPU arm (chip-independent; VERDICT r3 #4): seeded from the
+# round-3 DART T=1 checkpoint, iterate rollout -> oracle relabel ->
+# aggregate -> extend training, then the standardized 20-episode eval
+# (trained vs random vs oracle). Flags mirror the seed arm's train_meta
+# (seq_len 1, efficientnet_small, 64x96, float32, batch 16, ngram).
+#
+# Usage: setsid nohup env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+#          nice -n 19 bash scripts/dagger_arm.sh /root/learn_proof_dagger \
+#          >> artifacts/dagger_arm_r04.log 2>&1 < /dev/null &
+set -u
+WD="${1:?usage: dagger_arm.sh <workdir>}"
+cd "$(dirname "$0")/.."
+
+ARGS=(--workdir "$WD" --seq_len 1 --image_tokenizer efficientnet_small
+      --height 64 --width 96 --dtype float32 --batch 16 --embedder ngram
+      --run_tag r04dagger)
+
+echo "[dagger_arm $(date +%H:%M:%S)] stage dagger starting"
+python scripts/learn_proof.py "${ARGS[@]}" --stage dagger \
+  --dagger_rounds 3 --dagger_episodes 40 --dagger_extra_steps 5000 \
+  || { echo "[dagger_arm] stage dagger FAILED (rc=$?)"; }
+
+# Evaluate whatever checkpoint the loop reached — a partial arm is still a
+# measurement point (round-3 lesson: any 2500-step checkpoint is evaluable).
+echo "[dagger_arm $(date +%H:%M:%S)] stage eval starting"
+python scripts/learn_proof.py "${ARGS[@]}" --stage eval \
+  || { echo "[dagger_arm] stage eval FAILED (rc=$?)"; exit 1; }
+
+touch "$WD/dagger_done"
+echo "[dagger_arm $(date +%H:%M:%S)] complete"

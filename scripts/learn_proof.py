@@ -417,7 +417,7 @@ def stage_dagger(data_dir, train_dir):
     """
     import numpy as np
 
-    from rt1_tpu.data.collect import check_embedder_compatibility
+    from rt1_tpu.data.collect import check_embedder_compatibility, read_manifest
     from rt1_tpu.data.dagger import (
         DAGGER_HISTORY_KEYS,
         append_episodes_to_corpus,
@@ -430,6 +430,19 @@ def stage_dagger(data_dir, train_dir):
 
     _check_train_meta(train_dir, "dagger", EVAL_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="dagger")
+    # Aggregation must roll out under the corpus' own settings, or the
+    # manifest stamps become provenance lies (the failure class the
+    # manifest exists to prevent): validate before any episode is added.
+    manifest = read_manifest(data_dir) or {}
+    for key, mine in (("block_mode", FLAGS.block_mode), ("reward", REWARD)):
+        recorded = manifest.get(key, mine)
+        if recorded != mine:
+            raise ValueError(
+                f"dagger: corpus manifest records {key}={recorded!r} but "
+                f"this run would roll out with {mine!r}; aggregated "
+                f"episodes would silently mix task settings."
+            )
+    rollout_max_steps = int(manifest.get("max_steps", 80))
     history = []
     for rnd in range(FLAGS.dagger_rounds):
         latest = _latest_step(os.path.join(train_dir, "checkpoints"))
@@ -458,6 +471,7 @@ def stage_dagger(data_dir, train_dir):
             attempts += 1
             ep, success = collect_dagger_episode(
                 env, policy, oracle,
+                max_steps=rollout_max_steps,
                 beta=FLAGS.dagger_beta, rng=rng,
             )
             if ep is None:

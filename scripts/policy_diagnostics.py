@@ -127,10 +127,17 @@ def corpus_entropy(data_dir, n_episodes, vocab_size=256):
 
 def main(argv):
     del argv
+    from rt1_tpu import chip_claim
+
+    # Importing learn_proof set RT1_CHIP_GUARD_SELF, so the import guard
+    # stayed out — take the claim explicitly before ANY jax work can dial
+    # the chip. That includes --corpus_entropy: tokenize() is jnp ops, so
+    # the "data-only" mode still initializes a backend.
+    if chip_claim.axon_active():
+        chip_claim.acquire("policy_diagnostics")
     data_dir = os.path.join(FLAGS.workdir, "data")
     train_dir = os.path.join(FLAGS.workdir, "train")
     if FLAGS.corpus_entropy:
-        # Before the env/eval imports: this mode needs only numpy + data.
         report = corpus_entropy(data_dir, FLAGS.entropy_episodes)
         out = FLAGS.out or os.path.join(FLAGS.workdir, "corpus_entropy.json")
         with open(out, "w") as f:
@@ -141,6 +148,7 @@ def main(argv):
     from rt1_tpu.envs import blocks
     from rt1_tpu.envs.oracles import RRTPushOracle
     from rt1_tpu.eval.evaluate import build_eval_env
+
     learn_proof._check_train_meta(train_dir, "diagnostics",
                                   learn_proof.EVAL_META_KEYS)
     policy = learn_proof._restore_policy(train_dir, data_dir)

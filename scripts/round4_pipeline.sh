@@ -1,0 +1,253 @@
+#!/bin/bash
+# Round-4 pipeline: the two headline deliverables, ruthlessly ordered
+# (VERDICT r3 "next round" #1/#3/#4/#6):
+#
+#   A. First-ever uncontended TPU bench matrix (train/e2e/mfu/infer
+#      dense+pallas/ring-on-chip) -> TPU_VALIDATION_r04.json.
+#   B. Flagship DART learning proof: 400-episode DART corpus, B3 @ 128x224,
+#      >=50k steps at FULL LR on the chip, then the standardized
+#      trained/random/oracle eval.
+#   C. (CPU, chip-independent insurance) DAgger corrective-relabeling arm
+#      seeded from the round-3 DART checkpoint -> scripts/dagger_arm.sh.
+#
+# Wedge posture this round (new): probes NEVER get killed (claim-lock
+# transfer to a dangling child instead), at most ONE claimant exists at any
+# time (rt1_tpu/chip_claim.py lockfile), and failed attempts are spaced by
+# LONG quiet gaps — round 3 showed 10+ hours of continuous patient probing
+# never cleared a wedge, so this round tests the quiet-period hypothesis.
+# CPU jobs are SIGSTOPped while the bench matrix runs so the recorded
+# numbers are uncontended (round-3's only probe was 0.52x baseline purely
+# from host contention).
+#
+# Usage: setsid nohup bash scripts/round4_pipeline.sh \
+#            > artifacts/pipeline_r04.log 2>&1 < /dev/null &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+log() { echo "[pipeline $(date +%H:%M:%S)] $*"; }
+
+DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
+DAGGER_WORKDIR="${DAGGER_WORKDIR:-/root/learn_proof_dagger}"
+SEED_WORKDIR="${SEED_WORKDIR:-/root/learn_proof_dart}"
+DART_NOISE=0.005
+OUT="TPU_VALIDATION_r04.json"
+# Stop starting new chip work this long after launch (driver's round-end
+# bench must find a free claim); default 8h.
+DEADLINE_EPOCH="${DEADLINE_EPOCH:-$(( $(date +%s) + 28800 ))}"
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; }
+
+# ---- stage 0: claim status (stale locks reap themselves on acquire) ----
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m rt1_tpu.chip_claim status || true
+
+# ---- stage 0b: flagship DART corpus collection (background, CPU) ----
+collector_alive() {
+  pgrep -f "learn_proof.py --workdir $DART_CORPUS --stage collect" > /dev/null
+}
+if [ ! -f "$DART_CORPUS/data/manifest.json" ] && ! collector_alive; then
+  log "launching flagship DART collection (400 eps, noise $DART_NOISE)"
+  mkdir -p "$DART_CORPUS"
+  setsid nohup env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python scripts/learn_proof.py --workdir "$DART_CORPUS" --stage collect \
+    --episodes 400 --workers 2 --exec_noise_std "$DART_NOISE" \
+    --embedder ngram \
+    >> artifacts/collect_dart_flagship_r04.log 2>&1 < /dev/null &
+fi
+
+# ---- stage 0c: DAgger CPU arm (background, niced, chip-independent) ----
+dagger_alive() {
+  pgrep -f "learn_proof.py --workdir $DAGGER_WORKDIR" > /dev/null \
+    || pgrep -f "dagger_arm.sh $DAGGER_WORKDIR" > /dev/null
+}
+if [ ! -d "$DAGGER_WORKDIR" ] && [ -d "$SEED_WORKDIR/train/checkpoints" ]; then
+  log "seeding DAgger workdir from $SEED_WORKDIR"
+  mkdir -p "$DAGGER_WORKDIR"
+  # Episodes are immutable -> hardlink the big corpus; training state gets
+  # a REAL copy (checkpoint metadata may be updated in place).
+  cp -al "$SEED_WORKDIR/data" "$DAGGER_WORKDIR/data"
+  cp -a "$SEED_WORKDIR/train" "$DAGGER_WORKDIR/train"
+fi
+if [ -d "$DAGGER_WORKDIR" ] && [ ! -f "$DAGGER_WORKDIR/dagger_done" ] \
+    && ! dagger_alive; then
+  log "launching DAgger arm (nice 19) on $DAGGER_WORKDIR"
+  setsid nohup env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    nice -n 19 bash scripts/dagger_arm.sh "$DAGGER_WORKDIR" \
+    >> artifacts/dagger_arm_r04.log 2>&1 < /dev/null &
+fi
+
+# ---- chip helpers ----
+pause_cpu_jobs() {
+  # STOP (not kill) every CPU-hungry background job for the uncontended
+  # window; patterns never match this shell's own cmdline.
+  pkill -STOP -f "learn_proof.py --workdir" 2>/dev/null
+  pkill -STOP -f "multiprocessing.spawn import spawn_main" 2>/dev/null
+  pkill -STOP -f "dagger_arm.sh" 2>/dev/null
+}
+resume_cpu_jobs() {
+  pkill -CONT -f "dagger_arm.sh" 2>/dev/null
+  pkill -CONT -f "multiprocessing.spawn import spawn_main" 2>/dev/null
+  pkill -CONT -f "learn_proof.py --workdir" 2>/dev/null
+}
+
+probe_chip() {
+  # rc 0 = claimable now; 1 = claim failed (wedge); 2 = lock held;
+  # 3 = probe still waiting after 35 min (wedge, child left dangling with
+  # the lock). Outer python is CPU-pinned (never dials); the child gets
+  # the axon env back explicitly. Never kills anything.
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'EOF'
+import os, subprocess, sys
+sys.path.insert(0, os.getcwd())
+os.environ["RT1_CHIP_GUARD_SELF"] = "1"
+from rt1_tpu import chip_claim
+try:
+    claim = chip_claim.acquire("pipeline-probe", wait_s=60)
+except chip_claim.ChipClaimHeld as e:
+    print(f"probe: {e}", flush=True)
+    sys.exit(2)
+child_env = dict(os.environ)
+child_env.update({"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+                  "JAX_PLATFORMS": "axon"})
+p = subprocess.Popen(
+    [sys.executable, "-c", "import jax; jax.devices()"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    env=child_env, start_new_session=True,
+)
+try:
+    rc = p.wait(timeout=2100)
+except subprocess.TimeoutExpired:
+    claim.transfer(p.pid, tag="dangling-pipeline-probe")
+    print("probe: still claim-waiting after 35 min; left dangling with "
+          "the lock", flush=True)
+    sys.exit(3)
+sys.exit(0 if rc == 0 else 1)
+EOF
+}
+
+bench_complete() {
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$REPO/$OUT" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+MODES = ("bench_train", "bench_e2e", "bench_mfu",
+         "bench_infer_dense", "bench_infer_pallas")
+ring = r.get("ring_on_chip")
+ok = (
+    r.get("status") == "done"
+    and all(isinstance(r.get(m), dict) and "error" not in r[m] for m in MODES)
+    and isinstance(ring, dict) and ring.get("ok") is True
+)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# ---- stage 1: bench matrix, quiet-gap attempt loop ----
+bench_ok=0
+attempt=0
+if bench_complete; then
+  log "bench matrix already recorded ($OUT)"
+  bench_ok=1
+fi
+healthy_attempts=0
+while [ "$bench_ok" = 0 ] && ! past_deadline; do
+  attempt=$((attempt + 1))
+  log "chip probe, attempt $attempt"
+  rc=0; probe_chip || rc=$?
+  if [ "$rc" = 0 ]; then
+    log "chip claimable — pausing CPU jobs, running UNCONTENDED bench matrix"
+    healthy_attempts=$((healthy_attempts + 1))
+    pause_cpu_jobs
+    RT1_WAIT_MAX_PROBES=2 python scripts/tpu_validation.py --out "$OUT" \
+      || log "tpu_validation exited rc=$?"
+    resume_cpu_jobs
+    if bench_complete; then
+      log "bench matrix complete ($OUT)"
+      bench_ok=1
+      break
+    fi
+    if [ "$healthy_attempts" -ge 3 ]; then
+      # A healthy chip but a persistently incomplete matrix = a real mode
+      # failure (e.g. pallas lowering), recorded in $OUT — don't starve
+      # the learning arm re-proving it.
+      log "matrix incomplete after $healthy_attempts healthy attempts;" \
+          "accepting partial record and moving on"
+      break
+    fi
+    log "bench matrix incomplete after a healthy probe; short gap 600s"
+    sleep 600
+  else
+    log "chip not claimable (probe rc=$rc); quiet gap 3600s"
+    sleep 3600
+  fi
+done
+[ "$bench_ok" = 1 ] || log "bench matrix NOT recorded before deadline"
+
+# ---- stage 2: flagship DART learning proof on the chip ----
+fail=0
+for i in $(seq 1 240); do
+  [ -f "$DART_CORPUS/data/manifest.json" ] && break
+  if ! collector_alive; then
+    log "collector dead with no manifest; attempting shard salvage"
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python -c "
+import sys; sys.path.insert(0, '.')
+from rt1_tpu.data.collect import finalize_shards
+print(finalize_shards('$DART_CORPUS/data', embedder='ngram',
+                      reward='block2block', block_mode='BLOCK_4',
+                      max_steps=80, image_hw=None, workers=2, seed=0,
+                      exec_noise_std=$DART_NOISE))
+" || log "salvage failed"
+    break
+  fi
+  log "waiting for flagship DART corpus ($i)"
+  sleep 60
+done
+
+FLAG_ARGS=(--workdir "$DART_CORPUS" --seq_len 1 --batch 32 --constant_lr
+           --embedder ngram --num_steps 50000 --run_tag r04flag)
+if [ -f "$DART_CORPUS/data/manifest.json" ]; then
+  train_ok=0
+  for attempt in $(seq 1 24); do
+    past_deadline && break
+    log "flagship train attempt $attempt (50k steps, B3 128x224, full LR)"
+    rc=0
+    python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage train || rc=$?
+    if [ "$rc" = 0 ]; then train_ok=1; break; fi
+    log "train attempt $attempt rc=$rc; gap 1800s"
+    sleep 1800
+  done
+  latest=$(ls "$DART_CORPUS/train/checkpoints" 2>/dev/null | grep -E '^[0-9]+$' | sort -n | tail -1)
+  if [ -n "${latest:-}" ]; then
+    [ "$train_ok" = 1 ] || log "flagship train UNDERTRAINED (latest ${latest})"
+    for attempt in $(seq 1 12); do
+      log "flagship eval attempt $attempt (from ckpt ${latest})"
+      rc=0
+      python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage eval || rc=$?
+      [ "$rc" = 0 ] && break
+      sleep 900
+    done
+    log "flagship diagnostics (20 episodes) from latest checkpoint"
+    python scripts/policy_diagnostics.py "${FLAG_ARGS[@]}" \
+      --diag_episodes 20 \
+      --out "$REPO/artifacts/flagship_diag_r04.json" \
+      || log "diagnostics rc=$?"
+  else
+    log "flagship arm produced NO checkpoint"
+    fail=1
+  fi
+else
+  log "no flagship DART corpus; flagship arm skipped"
+  fail=1
+fi
+
+# ---- stage 3: wait for the DAgger arm (it logs its own results) ----
+for i in $(seq 1 240); do
+  [ -f "$DAGGER_WORKDIR/dagger_done" ] && { log "DAgger arm done"; break; }
+  dagger_alive || { log "DAgger arm not running and not done"; break; }
+  sleep 120
+done
+
+log "pipeline finished (fail=$fail, bench_ok=$bench_ok)"
+exit "$fail"

@@ -83,8 +83,12 @@ def test_collect_dagger_episode_labels_are_oracle_not_executed():
     # Same embedding every step (instruction fixed within an episode).
     assert np.allclose(episode["instruction"][0], episode["instruction"][-1])
     assert episode["is_first"].tolist() == [True] + [False] * (t - 1)
-    # Horizon exhaustion still closes the episode for the windowing loader.
-    assert bool(episode["is_terminal"][-1])
+    # is_terminal is the terminate_episode ACTION LABEL downstream, so it
+    # must be honest: a constant near-zero policy cannot have finished the
+    # task in 10 steps — a forced end-of-horizon terminal would teach the
+    # policy to emit terminate=1 mid-task on every failed rollout.
+    assert not success
+    assert not episode["is_terminal"].any()
     # encode_instruction_text yields a uint8 byte array (episodes.py).
     assert episode["instruction_text"].dtype == np.uint8
     assert episode["instruction_text"].size > 0
