@@ -3,9 +3,17 @@
 Parity source: reference `language_table/environments/oracles/rrt_star.py:
 25-357` (same algorithm, same tuning-parameter meanings). This version keeps
 the vertex set in growing numpy arrays so nearest-neighbor / neighborhood
-queries and segment-circle collision checks are vectorized instead of Python
-loops over node objects — the planner runs every few control steps in the
-eval loop, so host-side speed matters.
+queries are vectorized instead of Python loops over node objects — the
+planner runs every few control steps in the eval loop, so host-side speed
+matters.
+
+Collision checks, by contrast, are deliberately SCALAR: the board carries at
+most a handful of circular obstacles, and profiling the round-4 collector
+showed ~80% of episode-collection wall-clock inside numpy-per-call overhead
+of the old array-based `_collision_free` (≈130 µs/call across ~180k calls
+for six episodes). Plain float arithmetic over a prebuilt obstacle tuple
+list runs the same check in a few µs, which multiplies the throughput of
+demo collection, DAgger relabeling, and every oracle-driven eval.
 """
 
 import math
@@ -13,38 +21,22 @@ import math
 import numpy as np
 
 
-def _segment_hits_circles(p0, p1, centers, radii):
-    """Does segment p0->p1 pass within radii of any center? Vectorized."""
-    if len(centers) == 0:
-        return False
-    d = p1 - p0
-    d2 = float(d @ d)
-    if d2 == 0.0:
-        return False
-    t = np.clip(((centers - p0) @ d) / d2, 0.0, 1.0)
-    closest = p0 + t[:, None] * d
-    dist = np.linalg.norm(closest - centers, axis=1)
-    return bool(np.any(dist <= radii))
+def _obstacle_tuples(centers, radii):
+    """Precompute [(cx, cy, r^2), ...] Python floats for the scalar checks."""
+    return [
+        (float(c[0]), float(c[1]), float(r) * float(r))
+        for c, r in zip(np.asarray(centers).reshape(-1, 2), radii)
+    ]
 
 
-def _inside_circles(p, centers, radii):
-    if len(centers) == 0:
-        return False
-    return bool(np.any(np.linalg.norm(centers - p, axis=1) <= radii))
-
-
-def _inside_boundary(p, delta, x_range, y_range, boundary_width):
-    """Inside any of the four thin boundary strips (with margin delta)."""
-    x, y = p
-    x_min, x_max = x_range
-    y_min, y_max = y_range
-    w = boundary_width
-    return (
-        x <= x_min + w + delta
-        or x >= x_max - delta
-        or y <= y_min + w + delta
-        or y >= y_max - delta
-    )
+def _inside_circles(p, obstacle_tuples):
+    """Point inside any (inflated) obstacle; takes the prebuilt tuples."""
+    x, y = float(p[0]), float(p[1])
+    for cx, cy, r2 in obstacle_tuples:
+        px, py = cx - x, cy - y
+        if px * px + py * py <= r2:
+            return True
+    return False
 
 
 class RRTStarPlanner:
@@ -77,6 +69,9 @@ class RRTStarPlanner:
         self.radii = (
             np.asarray(obstacle_radii, dtype=np.float64).reshape(-1) + delta
         )
+        # Scalar-check working set (see module docstring): built once per
+        # plan, consumed millions of times.
+        self._obs = _obstacle_tuples(self.obstacles, self.radii)
         self.delta = delta
         self.step_length = step_length
         self.goal_sample_rate = goal_sample_rate
@@ -91,17 +86,43 @@ class RRTStarPlanner:
         self.tree_parent = np.zeros((0,), dtype=np.int64)
 
     def _collision_free(self, p0, p1):
-        if _inside_circles(p1, self.obstacles, self.radii):
-            return False
-        if _inside_boundary(
-            p1, self.delta, self.x_range, self.y_range, self.boundary_width
+        """Fused scalar form of: p1 outside every (inflated) obstacle AND
+        outside the boundary strips AND segment p0->p1 clear of every
+        obstacle. Semantics identical to the three vectorized helpers; the
+        per-call numpy overhead they carried dominated collection/eval
+        profiles (module docstring)."""
+        x1, y1 = float(p1[0]), float(p1[1])
+        x_min, x_max = self.x_range
+        y_min, y_max = self.y_range
+        margin = self.boundary_width + self.delta
+        if (
+            x1 <= x_min + margin
+            or x1 >= x_max - self.delta
+            or y1 <= y_min + margin
+            or y1 >= y_max - self.delta
         ):
             return False
-        return not _segment_hits_circles(p0, p1, self.obstacles, self.radii)
+        x0, y0 = float(p0[0]), float(p0[1])
+        dx, dy = x1 - x0, y1 - y0
+        d2 = dx * dx + dy * dy
+        for cx, cy, r2 in self._obs:
+            px, py = cx - x1, cy - y1
+            if px * px + py * py <= r2:
+                return False
+            if d2 > 0.0:
+                t = ((cx - x0) * dx + (cy - y0) * dy) / d2
+                if t < 0.0:
+                    t = 0.0
+                elif t > 1.0:
+                    t = 1.0
+                qx, qy = x0 + t * dx - cx, y0 + t * dy - cy
+                if qx * qx + qy * qy <= r2:
+                    return False
+        return True
 
     def plan(self):
         """Grow the tree; on success `self.path` is goal->start subgoals."""
-        if _inside_circles(self.start, self.obstacles, self.radii):
+        if _inside_circles(self.start, self._obs):
             # Start embedded in an obstacle: unplannable configuration.
             self.success = False
             return self
