@@ -57,12 +57,22 @@ def main():
     p.add_argument(
         "--attention_impl", default="dense", choices=["dense", "pallas"],
         help="infer mode: attention implementation under test.")
+    p.add_argument(
+        "--trace_dir", default="",
+        help="Capture a jax.profiler trace of the measured loop into this "
+             "directory (TensorBoard/XProf format; works on TPU and CPU) "
+             "for train/mfu/e2e/infer modes (env mode is host-only and "
+             "ignores it with a warning). Where the headline number comes "
+             "from is visible op-by-op there.")
     args = p.parse_args()
 
     import os
     import sys
 
     if args.mode == "env":
+        if args.trace_dir:
+            print("bench: --trace_dir is ignored in --mode env (host-only "
+                  "loop, no XLA programs to trace)", file=sys.stderr)
         return env_bench(args)
 
     def no_chip_sentinel(error):
@@ -175,16 +185,20 @@ def main():
     state = fns.shard_state(state)
     batch = fns.shard_batch((obs, actions))
 
-    def timed_resident_loop(state, steps, warmup, resident=None):
+    def timed_resident_loop(state, steps, warmup, resident=None, trace=False):
         resident = batch if resident is None else resident
         for i in range(warmup):
             state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, i))
             jax.block_until_ready(metrics["loss"])
-        t0 = time.perf_counter()
-        for i in range(steps):
-            state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, 100 + i))
-        jax.block_until_ready(metrics["loss"])
-        return state, time.perf_counter() - t0
+        with _maybe_trace(args.trace_dir if trace else ""):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, 100 + i))
+            jax.block_until_ready(metrics["loss"])
+            # dt read INSIDE the trace context: trace stop/serialization
+            # can take seconds and must not deflate the published number.
+            dt = time.perf_counter() - t0
+        return state, dt
 
     if args.mode == "mfu":
         return mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop)
@@ -192,7 +206,7 @@ def main():
     if args.mode == "e2e":
         return e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop)
 
-    state, dt = timed_resident_loop(state, args.steps, args.warmup)
+    state, dt = timed_resident_loop(state, args.steps, args.warmup, trace=True)
     steps_per_sec_per_chip = args.steps / dt / n_chips
     vs = _vs_baseline(steps_per_sec_per_chip, "train_steps_per_sec_per_chip")
     print(
@@ -205,6 +219,18 @@ def main():
             }
         )
     )
+
+
+def _maybe_trace(trace_dir):
+    """jax.profiler trace context when `trace_dir` is non-empty — the
+    op-by-op evidence behind whichever headline loop it wraps."""
+    import contextlib
+
+    if not trace_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(trace_dir)
 
 
 def _chip_probe(timeout=300, claim=None):
@@ -318,13 +344,17 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
     # dtype-variant compute delta would masquerade as input stall.
     resident = next(feed)
 
-    t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = fns.train_step(
-            state, next(feed), jax.random.fold_in(rng, 100 + i)
-        )
-    jax.block_until_ready(metrics["loss"])
-    dt_e2e = time.perf_counter() - t0
+    # The trace wraps the E2E loop (the mode's headline), and the
+    # compute-only baseline runs untraced so trace overhead can't inflate
+    # dt_compute and understate stall_pct.
+    with _maybe_trace(args.trace_dir):
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, metrics = fns.train_step(
+                state, next(feed), jax.random.fold_in(rng, 100 + i)
+            )
+        jax.block_until_ready(metrics["loss"])
+        dt_e2e = time.perf_counter() - t0
 
     state, dt_compute = timed_resident_loop(state, args.steps, 1, resident=resident)
 
@@ -484,11 +514,12 @@ def infer_bench(args, model, rng, obs, actions):
     jax.block_until_ready(out["action_tokens"])
 
     times = []
-    for _ in range(args.steps):
-        t0 = time.perf_counter()
-        out, state = step(variables, frame, state)
-        jax.block_until_ready(out["action_tokens"])
-        times.append((time.perf_counter() - t0) * 1000.0)
+    with _maybe_trace(args.trace_dir):
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            out, state = step(variables, frame, state)
+            jax.block_until_ready(out["action_tokens"])
+            times.append((time.perf_counter() - t0) * 1000.0)
     p50 = statistics.median(times)
     print(
         json.dumps(
