@@ -312,6 +312,25 @@ def test_learn_proof_corpus_accounting_from_manifest(tmp_path):
     assert splits == {"train": 5, "val": 2, "test": 1}
 
 
+def test_learn_proof_constant_lr_pushes_milestones_past_horizon():
+    """--constant_lr (round-4 recipe: full LR for >=50k steps) must place
+    every MultiStepLR boundary beyond the training horizon, while the
+    default keeps the reference's 50/75/90% decay shape."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import learn_proof
+
+    if not learn_proof.FLAGS.is_parsed():
+        learn_proof.FLAGS(["learn_proof"])
+    num_steps = 1000
+    const = learn_proof.get_train_config("/tmp/x", num_steps, constant_lr=True)
+    assert min(const.lr_milestones) * const.steps_per_epoch > num_steps
+    decay = learn_proof.get_train_config("/tmp/x", num_steps, constant_lr=False)
+    boundaries = [m * decay.steps_per_epoch for m in decay.lr_milestones]
+    assert boundaries == [500, 750, 900]
+
+
 @pytest.mark.slow
 def test_collect_lifecycle(tmp_path):
     """collect -> real-data train: the hermetic data-generation path."""
