@@ -443,58 +443,100 @@ def stage_dagger(data_dir, train_dir):
                 f"episodes would silently mix task settings."
             )
     rollout_max_steps = int(manifest.get("max_steps", 80))
-    history = []
-    for rnd in range(FLAGS.dagger_rounds):
-        latest = _latest_step(os.path.join(train_dir, "checkpoints"))
-        if latest is None:
-            raise RuntimeError(
-                "dagger: no checkpoint to roll out; run --stage train first"
-            )
-        policy = _restore_policy(train_dir, data_dir)
-        env = build_eval_env(
-            reward_name=REWARD,
-            block_mode=blocks.BlockMode(FLAGS.block_mode),
-            seed=DAGGER_SEED + 1000 * rnd,
-            embedder=FLAGS.embedder,
-            target_height=FLAGS.height,
-            target_width=FLAGS.width,
-            sequence_length=FLAGS.seq_len,
-            history_keys=DAGGER_HISTORY_KEYS,
+    # Two-phase resumable state (host resets are routine here):
+    #   phase A (aggregated_round=k, written BEFORE training) makes the
+    #     rollout+aggregation of round k idempotent — a crash during the
+    #     much-longer training extension must not re-append round k's
+    #     episodes to the corpus on resume;
+    #   phase B (completed_rounds=k+1, written after training) advances.
+    # Round step targets derive from the base checkpoint recorded at first
+    # entry (base + (k+1)*extra), so a mid-training crash cannot inflate a
+    # round's step budget via the mid-extension checkpoint. The state file
+    # is deleted once the summary is archived: it is crash-resume state,
+    # not run provenance (that's dagger_rounds.json).
+    state_path = os.path.join(FLAGS.workdir, "dagger_state.json")
+    latest = _latest_step(os.path.join(train_dir, "checkpoints"))
+    if latest is None:
+        raise RuntimeError(
+            "dagger: no checkpoint to roll out; run --stage train first"
         )
-        oracle = RRTPushOracle(env, use_ee_planner=True)
-        rng = np.random.default_rng(DAGGER_SEED + rnd)
-        episodes, successes, attempts = [], 0, 0
-        while (
-            len(episodes) < FLAGS.dagger_episodes
-            and attempts < 5 * FLAGS.dagger_episodes
-        ):
-            attempts += 1
-            ep, success = collect_dagger_episode(
-                env, policy, oracle,
-                max_steps=rollout_max_steps,
-                beta=FLAGS.dagger_beta, rng=rng,
+    state = {
+        "completed_rounds": 0,
+        "rounds": [],
+        "aggregated_round": None,
+        "base_step": latest,
+    }
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            state = json.load(f)
+        print(f"dagger: resuming at round {state['completed_rounds']} "
+              f"(aggregated_round={state['aggregated_round']}, "
+              f"base_step={state['base_step']})")
+
+    def checkpoint_state():
+        with open(state_path + ".tmp", "w") as f:
+            json.dump(state, f, indent=2)
+        os.replace(state_path + ".tmp", state_path)
+
+    history = state["rounds"]
+    for rnd in range(state["completed_rounds"], FLAGS.dagger_rounds):
+        if state["aggregated_round"] == rnd:
+            print(f"dagger round {rnd}: already aggregated; resuming training")
+        else:
+            policy = _restore_policy(train_dir, data_dir)
+            env = build_eval_env(
+                reward_name=REWARD,
+                block_mode=blocks.BlockMode(FLAGS.block_mode),
+                seed=DAGGER_SEED + 1000 * rnd,
+                embedder=FLAGS.embedder,
+                target_height=FLAGS.height,
+                target_width=FLAGS.width,
+                sequence_length=FLAGS.seq_len,
+                history_keys=DAGGER_HISTORY_KEYS,
             )
-            if ep is None:
-                continue  # init had no collision-free plan; re-randomized
-            episodes.append(ep)
-            successes += int(success)
-        total = append_episodes_to_corpus(data_dir, episodes)
-        entry = {
-            "round": rnd,
-            "from_checkpoint": latest,
-            "rollout_episodes": len(episodes),
-            "rollout_successes": successes,
-            "corpus_train_episodes_after": total,
-        }
-        history.append(entry)
-        print(f"dagger round {rnd}: {entry}")
+            oracle = RRTPushOracle(env, use_ee_planner=True)
+            rng = np.random.default_rng(DAGGER_SEED + rnd)
+            episodes, successes, attempts = [], 0, 0
+            while (
+                len(episodes) < FLAGS.dagger_episodes
+                and attempts < 5 * FLAGS.dagger_episodes
+            ):
+                attempts += 1
+                ep, success = collect_dagger_episode(
+                    env, policy, oracle,
+                    max_steps=rollout_max_steps,
+                    beta=FLAGS.dagger_beta, rng=rng,
+                )
+                if ep is None:
+                    continue  # init had no collision-free plan; re-randomized
+                episodes.append(ep)
+                successes += int(success)
+            total = append_episodes_to_corpus(data_dir, episodes)
+            entry = {
+                "round": rnd,
+                "from_checkpoint": _latest_step(
+                    os.path.join(train_dir, "checkpoints")
+                ),
+                "rollout_episodes": len(episodes),
+                "rollout_successes": successes,
+                "corpus_train_episodes_after": total,
+            }
+            history.append(entry)
+            state["aggregated_round"] = rnd
+            checkpoint_state()  # phase A durable BEFORE the long training
+            print(f"dagger round {rnd}: {entry}")
 
         # Full LR throughout (constant_lr): every aggregation shifts the
         # data distribution, so the reference schedule's late-run decay
-        # would freeze the policy precisely when its corpus changes.
-        target = latest + FLAGS.dagger_extra_steps
+        # would freeze the policy precisely when its corpus changes. The
+        # target derives from base_step, never from a mid-extension
+        # checkpoint.
+        target = state["base_step"] + (rnd + 1) * FLAGS.dagger_extra_steps
         config = get_train_config(data_dir, target, constant_lr=True)
         train_and_evaluate(config, train_dir)
+        state["completed_rounds"] = rnd + 1
+        state["aggregated_round"] = None
+        checkpoint_state()
 
     summary_path = os.path.join(FLAGS.workdir, "dagger_rounds.json")
     with open(summary_path + ".tmp", "w") as f:
@@ -502,6 +544,12 @@ def stage_dagger(data_dir, train_dir):
     os.replace(summary_path + ".tmp", summary_path)
     tag = os.path.basename(os.path.normpath(FLAGS.workdir))
     _archive(summary_path, f"{tag}_dagger_rounds_{FLAGS.run_tag}.json")
+    # Crash-resume state only — a completed run must not make a later fresh
+    # run in the same workdir silently skip its rounds.
+    try:
+        os.unlink(state_path)
+    except FileNotFoundError:
+        pass
     return history
 
 
