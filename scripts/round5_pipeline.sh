@@ -1,0 +1,264 @@
+#!/bin/bash
+# Round-5 hour-zero pipeline: automatic reset-recovery (VERDICT r4 weak #1 /
+# next #1). Launched detached at round start and left running; the moment
+# the chip becomes claimable it fires, unattended:
+#
+#   A. Uncontended bench matrix (train / e2e+stall / MFU / infer dense+pallas
+#      / ring-on-chip) -> TPU_VALIDATION_r05.json, then merges the numbers
+#      into BASELINE.json["published"] (first-ever e2e/mfu/infer/pallas keys).
+#   B. Flagship DART learning arm on the chip: 400-ep corpus (already at
+#      /root/learn_proof_dart_flagship), B3 @ 128x224, 50k steps full LR,
+#      formal eval + diagnostics, then on-chip DAgger from the checkpoint.
+#
+# Reset-detection posture (round-4 record: the wedge survives everything
+# client-side; ONLY remote host resets clear it; the relay stays TCP-alive
+# at 127.0.0.1:2024 while wedged):
+#   * Full claim probes at most hourly (quiet-gap discipline), never killed,
+#     single claimant under rt1_tpu/chip_claim.py.
+#   * Between probes, a cheap TCP check on the relay every 60 s. A
+#     down->up transition is the signature of the remote host rebooting, so
+#     it short-circuits the quiet gap and probes immediately — the "fire the
+#     moment the host comes back" watcher VERDICT asked for.
+#
+# Usage: setsid nohup bash scripts/round5_pipeline.sh \
+#            > artifacts/pipeline_r05.log 2>&1 < /dev/null &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+log() { echo "[pipeline $(date +%H:%M:%S)] $*"; }
+
+DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
+OUT="TPU_VALIDATION_r05.json"
+RELAY_HOST=127.0.0.1
+RELAY_PORT=2024
+# Stop starting new chip work this long after launch (the driver's
+# round-end bench must find a free claim); default 9h.
+DEADLINE_EPOCH="${DEADLINE_EPOCH:-$(( $(date +%s) + 32400 ))}"
+
+past_deadline() { [ "$(date +%s)" -ge "$DEADLINE_EPOCH" ]; }
+
+relay_up() { timeout 2 bash -c "</dev/tcp/$RELAY_HOST/$RELAY_PORT" 2>/dev/null; }
+
+pause_cpu_jobs() {
+  # STOP (not kill) CPU-hungry background jobs for the uncontended window;
+  # patterns never match this shell's own cmdline.
+  pkill -STOP -f "learn_proof.py --workdir" 2>/dev/null
+  pkill -STOP -f "multiprocessing.spawn import spawn_main" 2>/dev/null
+  pkill -STOP -f "capacity_arm" 2>/dev/null
+  pkill -STOP -f "pretrain_vision" 2>/dev/null
+}
+resume_cpu_jobs() {
+  pkill -CONT -f "pretrain_vision" 2>/dev/null
+  pkill -CONT -f "capacity_arm" 2>/dev/null
+  pkill -CONT -f "multiprocessing.spawn import spawn_main" 2>/dev/null
+  pkill -CONT -f "learn_proof.py --workdir" 2>/dev/null
+}
+
+probe_chip() {
+  # rc 0 = claimable now; 1 = claim failed (wedge); 2 = lock held;
+  # 3 = probe still waiting after 35 min (wedge; child left dangling WITH
+  # the lock — never killed).
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<'EOF'
+import os, subprocess, sys
+sys.path.insert(0, os.getcwd())
+os.environ["RT1_CHIP_GUARD_SELF"] = "1"
+from rt1_tpu import chip_claim
+try:
+    claim = chip_claim.acquire("r05-pipeline-probe", wait_s=60)
+except chip_claim.ChipClaimHeld as e:
+    print(f"probe: {e}", flush=True)
+    sys.exit(2)
+child_env = dict(os.environ)
+child_env.update({"PALLAS_AXON_POOL_IPS": "127.0.0.1",
+                  "JAX_PLATFORMS": "axon"})
+p = subprocess.Popen(
+    [sys.executable, "-c", "import jax; jax.devices()"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    env=child_env, start_new_session=True,
+)
+try:
+    rc = p.wait(timeout=2100)
+except subprocess.TimeoutExpired:
+    claim.transfer(p.pid, tag="dangling-chip-probe")
+    print("probe: still claim-waiting after 35 min; left dangling with "
+          "the lock", flush=True)
+    sys.exit(3)
+sys.exit(0 if rc == 0 else 1)
+EOF
+}
+
+# Quiet gap between failed probes, short-circuited by a relay down->up
+# transition (remote reboot signature).
+watch_gap() {
+  local total="$1" waited=0 was_up=1 now_up
+  relay_up && was_up=1 || was_up=0
+  while [ "$waited" -lt "$total" ]; do
+    past_deadline && return 0
+    sleep 60; waited=$((waited + 60))
+    relay_up && now_up=1 || now_up=0
+    if [ "$was_up" = 0 ] && [ "$now_up" = 1 ]; then
+      log "relay transition DOWN->UP after ${waited}s — remote reset" \
+          "signature, probing immediately"
+      return 0
+    fi
+    [ "$now_up" != "$was_up" ] && log "relay state change: up=$now_up"
+    was_up=$now_up
+  done
+}
+
+bench_complete() {
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$REPO/$OUT" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+MODES = ("bench_train", "bench_e2e", "bench_mfu",
+         "bench_infer_dense", "bench_infer_pallas")
+ring = r.get("ring_on_chip")
+ok = (
+    r.get("status") == "done"
+    and all(isinstance(r.get(m), dict) and "error" not in r[m] for m in MODES)
+    and isinstance(ring, dict) and ring.get("ok") is True
+)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+merge_baseline() {
+  # First-ever e2e/mfu/infer/pallas published keys (VERDICT r4 weak #6).
+  env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - "$REPO/$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+try:
+    r = json.load(open(out))
+    b = json.load(open("BASELINE.json"))
+except Exception as e:
+    print(f"merge_baseline: {e}"); sys.exit(1)
+pub = b.setdefault("published", {})
+def put(key, mode, field):
+    m = r.get(mode)
+    if isinstance(m, dict) and "error" not in m and field in m:
+        pub[key] = m[field]
+mapping = [
+    ("train_steps_per_sec_per_chip", "bench_train", "value"),
+    ("train_steps_per_sec_per_chip_e2e", "bench_e2e", "value"),
+    ("train_step_mfu_pct", "bench_mfu", "value"),
+    ("infer_p50_ms_dense", "bench_infer_dense", "value"),
+    ("infer_p50_ms_pallas", "bench_infer_pallas", "value"),
+]
+before = dict(pub)
+for k, mode, f in mapping:
+    put(k, mode, f)
+if pub != before:
+    pub["tpu_matrix_recorded_round"] = 5
+    json.dump(b, open("BASELINE.json", "w"), indent=2)
+    print("merge_baseline: published keys updated:",
+          sorted(set(pub) - set(before) | {k for k in before if pub.get(k) != before[k]}))
+else:
+    print("merge_baseline: nothing to merge")
+EOF
+}
+
+log "round-5 pipeline up; deadline $(date -d "@$DEADLINE_EPOCH" +%H:%M:%S)"
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m rt1_tpu.chip_claim status || true
+
+# ---- stage 1: bench matrix, watched quiet-gap loop ----
+bench_ok=0
+attempt=0
+healthy_attempts=0
+if bench_complete; then
+  log "bench matrix already recorded ($OUT)"
+  bench_ok=1
+fi
+while [ "$bench_ok" = 0 ] && ! past_deadline; do
+  attempt=$((attempt + 1))
+  log "chip probe, attempt $attempt"
+  rc=0; probe_chip || rc=$?
+  if [ "$rc" = 0 ]; then
+    log "CHIP CLAIMABLE — pausing CPU jobs, running UNCONTENDED bench matrix"
+    healthy_attempts=$((healthy_attempts + 1))
+    pause_cpu_jobs
+    RT1_WAIT_MAX_PROBES=2 python scripts/tpu_validation.py --out "$OUT" \
+      || log "tpu_validation exited rc=$?"
+    resume_cpu_jobs
+    if bench_complete; then
+      log "bench matrix complete ($OUT)"
+      merge_baseline || true
+      bench_ok=1
+      break
+    fi
+    if [ "$healthy_attempts" -ge 3 ]; then
+      log "matrix incomplete after $healthy_attempts healthy attempts;" \
+          "accepting partial record and moving on"
+      merge_baseline || true
+      break
+    fi
+    log "bench matrix incomplete after a healthy probe; short gap 600s"
+    sleep 600
+  elif [ "$rc" = 2 ]; then
+    log "claim lock held by another job; short gap 300s"
+    sleep 300
+  else
+    log "chip not claimable (probe rc=$rc); watched quiet gap 3600s"
+    watch_gap 3600
+  fi
+done
+[ "$bench_ok" = 1 ] || log "bench matrix NOT recorded before deadline"
+
+# ---- stage 2: flagship DART learning arm on the chip ----
+fail=0
+FLAG_ARGS=(--workdir "$DART_CORPUS" --seq_len 1 --batch 32 --constant_lr
+           --embedder ngram --num_steps 50000 --run_tag r05flag)
+if [ -f "$DART_CORPUS/data/manifest.json" ]; then
+  train_ok=0
+  for attempt in $(seq 1 24); do
+    past_deadline && break
+    # Train only fires when a probe says the chip is healthy; a wedged
+    # claim inside learn_proof would burn a 25-min failure per attempt.
+    rc=0; probe_chip || rc=$?
+    if [ "$rc" != 0 ]; then
+      log "flagship train: chip not claimable (rc=$rc); watched gap 3600s"
+      watch_gap 3600
+      continue
+    fi
+    log "flagship train attempt $attempt (50k steps, B3 128x224, full LR)"
+    rc=0
+    python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage train || rc=$?
+    if [ "$rc" = 0 ]; then train_ok=1; break; fi
+    log "train attempt $attempt rc=$rc; gap 1800s"
+    sleep 1800
+  done
+  latest=$(ls "$DART_CORPUS/train/checkpoints" 2>/dev/null \
+           | grep -E '^[0-9]+$' | sort -n | tail -1)
+  if [ -n "${latest:-}" ]; then
+    [ "$train_ok" = 1 ] || log "flagship train UNDERTRAINED (latest ${latest})"
+    for attempt in $(seq 1 12); do
+      log "flagship eval attempt $attempt (from ckpt ${latest})"
+      rc=0
+      python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage eval || rc=$?
+      [ "$rc" = 0 ] && break
+      sleep 900
+    done
+    log "flagship diagnostics (20 episodes) from latest checkpoint"
+    python scripts/policy_diagnostics.py "${FLAG_ARGS[@]}" \
+      --diag_episodes 20 \
+      --out "$REPO/artifacts/flagship_diag_r05.json" \
+      || log "diagnostics rc=$?"
+    if [ "$train_ok" = 1 ] && ! past_deadline; then
+      log "flagship on-chip DAgger from ck${latest}"
+      python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage dagger \
+        || log "dagger rc=$?"
+    fi
+  else
+    log "flagship arm produced NO checkpoint"
+    fail=1
+  fi
+else
+  log "no flagship DART corpus at $DART_CORPUS; flagship arm skipped"
+  fail=1
+fi
+
+log "pipeline finished (fail=$fail, bench_ok=$bench_ok)"
+exit "$fail"
