@@ -88,12 +88,37 @@ def axon_active() -> bool:
     return "axon" in platforms or "tpu" in platforms
 
 
-def _pid_alive(pid: int) -> bool:
+def _pid_start(pid: int) -> int | None:
+    """Kernel start time (clock ticks since boot) of `pid`, or None.
+
+    /proc/<pid>/stat field 22; parsed from after the last ')' because the
+    comm field may itself contain spaces or parens.
+    """
+    try:
+        with open(f"/proc/{int(pid)}/stat", "rb") as f:
+            stat = f.read()
+        rest = stat[stat.rindex(b")") + 1:].split()
+        # rest[0] is field 3 (state); starttime is field 22 -> rest[19].
+        return int(rest[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _pid_alive(pid: int, expected_start: int | None = None) -> bool:
     try:
         with open(f"/proc/{int(pid)}/cmdline", "rb") as f:
             cmdline = f.read()
     except (OSError, ValueError):
         return False
+    if expected_start is not None:
+        # Pid-recycling detector (ADVICE r4): the lock records the holder's
+        # kernel start time; a same-pid process with a different start time
+        # is a recycled pid, not the holder — without this, any long-lived
+        # python process that reuses the pid makes a stale lock look held
+        # forever (blocking all claims until a manual `clear`).
+        actual = _pid_start(pid)
+        if actual is not None and actual != expected_start:
+            return False
     if not cmdline.strip(b"\0"):
         # Mid-exec (fork->exec window) or zombie: the pid exists but its
         # cmdline is momentarily empty. Err on the side of "alive" — a
@@ -102,6 +127,12 @@ def _pid_alive(pid: int) -> bool:
         # waits/refuses until the state resolves.
         return True
     return any(m in cmdline for m in _HOLDER_CMD_MARKERS)
+
+
+def _record_alive(record: dict) -> bool:
+    """Liveness of a lock record's holder, start-time-verified when the
+    record carries one (records from older code lack `pid_start`)."""
+    return _pid_alive(record.get("pid", -1), record.get("pid_start"))
 
 
 def holder(path: str | None = None) -> dict | None:
@@ -198,7 +229,8 @@ def _write_lock(path: str, *, pid: int, tag: str, token: str) -> None:
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(
-            {"pid": pid, "tag": tag, "token": token, "created": time.time()},
+            {"pid": pid, "tag": tag, "token": token,
+             "pid_start": _pid_start(pid), "created": time.time()},
             f,
         )
     os.replace(tmp, path)
@@ -245,6 +277,7 @@ def acquire(tag: str, path: str | None = None, wait_s: float = 0.0,
                     "pid": os.getpid(),
                     "tag": tag,
                     "token": token,
+                    "pid_start": _pid_start(os.getpid()),
                     "created": time.time(),
                 },
                 f,
@@ -258,7 +291,7 @@ def acquire(tag: str, path: str | None = None, wait_s: float = 0.0,
                 if os.path.exists(path):
                     _reap(path, None)
                 continue
-            if not _pid_alive(record.get("pid", -1)):
+            if not _record_alive(record):
                 # Stale: holder died (possibly SIGKILL'd — atexit skipped).
                 # Checked BEFORE the token umbrella: a child inheriting the
                 # token of a dead parent must not join a defunct umbrella
@@ -338,13 +371,13 @@ def main(argv=None) -> int:
                         "locked": True,
                         "path": path,
                         "holder": record,
-                        "holder_alive": _pid_alive(record.get("pid", -1)),
+                        "holder_alive": _record_alive(record),
                     }
                 )
             )
         return 0
     if cmd == "clear":
-        if record is not None and _pid_alive(record.get("pid", -1)):
+        if record is not None and _record_alive(record):
             print(
                 f"refusing to clear: holder pid {record['pid']} is alive "
                 f"({record.get('tag')!r}). Kill/stop it first (SIGINT, "

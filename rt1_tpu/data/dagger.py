@@ -79,9 +79,15 @@ def collect_dagger_episode(
         label = np.asarray(
             oracle.action(env.compute_state()), np.float32
         )
-        exec_action = label
-        if not (beta and rng.random() < beta):
-            exec_action = np.asarray(policy.action(obs), np.float32)
+        # The policy is queried EVERY step, even when the oracle's action is
+        # the one executed (beta-mixing): RT1EvalPolicy advances its rolling
+        # network_state only inside action(), so skipping the query on
+        # oracle-executed steps would condition later policy actions on a
+        # gapped temporal window unlike eval-time execution (ADVICE r4).
+        proposed = np.asarray(policy.action(obs), np.float32)
+        exec_action = proposed
+        if beta and rng.random() < beta:
+            exec_action = label
         rgb = np.asarray(obs["rgb"][-1])  # native uint8 frame
         if image_hw is not None:
             rgb = cv2.resize(
@@ -118,26 +124,73 @@ def append_episodes_to_corpus(data_dir, episodes, split="train"):
     callers must roll out under the corpus' own settings
     (`scripts/learn_proof.py::stage_dagger` validates its flags against
     the manifest before collecting).
+
+    Crash-safety (ADVICE r4): episodes are staged in a hidden temp subdir
+    and renamed into the split only when all are written, and the manifest's
+    episode totals are RECONCILED from the on-disk file count rather than
+    incremented — so a kill between the renames and the manifest write (or
+    any orphan files a previous crash left behind) is absorbed by the next
+    successful aggregation instead of silently diverging from disk.
     """
-    split_dir = os.path.join(data_dir, split)
-    os.makedirs(split_dir, exist_ok=True)
-    existing = sum(
-        1 for f in os.listdir(split_dir)
-        if f.startswith("episode_") and f.endswith(".npz")
-    )
-    for i, episode in enumerate(episodes):
-        save_episode(
-            os.path.join(split_dir, f"episode_{existing + i}.npz"), episode
-        )
     manifest = read_manifest(data_dir)
     if manifest is None:
         raise FileNotFoundError(
             f"{data_dir} has no manifest.json — aggregate only into "
             f"corpora produced by rt1_tpu.data.collect"
         )
-    manifest["episodes"] = manifest.get("episodes", 0) + len(episodes)
-    manifest["dagger_episodes"] = (
-        manifest.get("dagger_episodes", 0) + len(episodes)
-    )
+    import shutil
+    import uuid
+
+    def _count(d):
+        return sum(
+            1 for f in os.listdir(d)
+            if f.startswith("episode_") and f.endswith(".npz")
+        )
+
+    def _disk_total():
+        total = 0
+        for entry in os.listdir(data_dir):
+            sub = os.path.join(data_dir, entry)
+            if os.path.isdir(sub) and not entry.startswith((".", "_")):
+                total += _count(sub)
+        return total
+
+    split_dir = os.path.join(data_dir, split)
+    os.makedirs(split_dir, exist_ok=True)
+    # Sweep stage dirs a crashed aggregation left behind (their contents
+    # were never renamed in, so they are safe to drop).
+    for entry in os.listdir(split_dir):
+        if entry.startswith(".dagger_stage."):
+            shutil.rmtree(os.path.join(split_dir, entry), ignore_errors=True)
+
+    # The collect-time episode count, stamped once on first aggregation;
+    # dagger_episodes is everything on disk beyond it. Clamped to the
+    # pre-append disk total so a manifest that over-counts reality (e.g. a
+    # truncated corpus) can't freeze a baseline that drives the dagger
+    # counter negative.
+    baseline = manifest.get("collected_episodes")
+    if baseline is None:
+        baseline = manifest.get("episodes", 0) - manifest.get(
+            "dagger_episodes", 0
+        )
+    baseline = min(baseline, _disk_total())
+
+    existing = _count(split_dir)
+    stage_dir = os.path.join(split_dir, f".dagger_stage.{uuid.uuid4().hex}")
+    os.makedirs(stage_dir)
+    try:
+        names = [f"episode_{existing + i}.npz" for i in range(len(episodes))]
+        for name, episode in zip(names, episodes):
+            save_episode(os.path.join(stage_dir, name), episode)
+        for name in names:
+            os.replace(
+                os.path.join(stage_dir, name), os.path.join(split_dir, name)
+            )
+    finally:
+        shutil.rmtree(stage_dir, ignore_errors=True)
+
+    manifest["collected_episodes"] = baseline
+    manifest["episodes"] = _disk_total()
+    manifest["dagger_episodes"] = manifest["episodes"] - baseline
     write_manifest(data_dir, **manifest)
     return existing + len(episodes)

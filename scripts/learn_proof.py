@@ -601,7 +601,13 @@ def stage_eval(train_dir, data_dir):
         "episodes_collected": episodes_collected,
         "episodes_by_split": split_counts,
         "exec_noise_std": corpus_noise,
-        "train_steps": FLAGS.num_steps,
+        # Provenance from reality, not the flag (ADVICE r4): after DAgger
+        # the evaluated checkpoint sits at base + rounds*extra steps, which
+        # FLAGS.num_steps knows nothing about.
+        "train_steps_requested": FLAGS.num_steps,
+        "evaluated_checkpoint_step": _latest_step(
+            os.path.join(train_dir, "checkpoints")
+        ),
         "seq_len": FLAGS.seq_len,
         "focal_gamma": FLAGS.focal_gamma,
         "aux_mse_weight": FLAGS.aux_mse_weight,

@@ -75,6 +75,36 @@ def test_stale_lock_is_reaped(lock):
     claim.release()
 
 
+def test_recycled_pid_lock_is_reaped(lock):
+    """ADVICE r4: a recycled pid whose new occupant is a long-lived python
+    process must not make a stale lock look held forever. The lock records
+    the holder's kernel start time; same pid + different start time = dead
+    holder."""
+    # Use our own (live, python) pid so the cmdline marker check passes,
+    # but stamp a start time that cannot match any live process.
+    record = {"pid": os.getpid(), "tag": "ghost", "token": "dead",
+              "pid_start": 1, "created": 0.0}
+    with open(lock, "w") as f:
+        json.dump(record, f)
+    assert not chip_claim._record_alive(record)
+    claim = chip_claim.acquire("test", path=lock)
+    assert claim.owned
+    assert chip_claim.holder(lock)["pid"] == os.getpid()
+    claim.release()
+
+
+def test_matching_pid_start_still_counts_as_held(lock):
+    # A fresh acquire stamps our own start time; a second claimant reading
+    # the record must agree the holder is alive (no false staleness).
+    claim = chip_claim.acquire("self", path=lock)
+    try:
+        record = chip_claim.holder(lock)
+        assert record["pid_start"] == chip_claim._pid_start(os.getpid())
+        assert chip_claim._record_alive(record)
+    finally:
+        claim.release()
+
+
 def test_token_umbrella_joins_parent_claim(lock, monkeypatch):
     parent = chip_claim.acquire("parent", path=lock)
     # A child inherits the token env; its acquire joins instead of raising.

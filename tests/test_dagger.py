@@ -114,6 +114,11 @@ def test_collect_dagger_beta_one_executes_oracle():
     # With the oracle executing its own plan, labels == executed actions,
     # and the rollout must not sit still: the effector moved.
     assert float(np.abs(episode["action"]).max()) > 1e-4
+    # The policy was QUERIED at every step even though it never drove
+    # (ADVICE r4): RT1EvalPolicy advances its rolling network_state only
+    # inside action(), so a gapped query stream would condition later
+    # actions on a stale temporal window unlike eval-time execution.
+    assert policy.calls == episode["action"].shape[0]
 
 
 def test_collect_dagger_beta_requires_rng():
@@ -161,6 +166,48 @@ def test_append_episodes_to_corpus_bookkeeping(tmp_path):
     total = append_episodes_to_corpus(data_dir, [fake_episode(3)])
     assert total == 5
     assert read_manifest(data_dir)["dagger_episodes"] == 3
+
+
+def test_append_reconciles_orphans_from_crashed_aggregation(tmp_path):
+    """ADVICE r4: a kill between episode writes and the manifest update
+    leaves orphan episode files the manifest never counted. The next
+    successful aggregation must absorb them (manifest == disk) instead of
+    letting accounting silently diverge."""
+    data_dir = str(tmp_path / "data")
+    os.makedirs(os.path.join(data_dir, "train"))
+    for i in range(2):
+        with open(
+            os.path.join(data_dir, "train", f"episode_{i}.npz"), "wb"
+        ) as f:
+            f.write(b"x")
+    write_manifest(data_dir, episodes=2, embedder="hash", seed=0)
+    # Simulate the crash artifact: two orphan episodes on disk, manifest
+    # still says 2.
+    for i in (2, 3):
+        with open(
+            os.path.join(data_dir, "train", f"episode_{i}.npz"), "wb"
+        ) as f:
+            f.write(b"x")
+
+    episode = {
+        "action": np.zeros((3, 2), np.float32),
+        "is_first": np.array([True, False, False]),
+        "is_terminal": np.array([False, False, True]),
+        "rgb": np.zeros((3, 4, 6, 3), np.uint8),
+        "instruction": np.zeros((3, 512), np.float32),
+        "instruction_text": b"push it",
+    }
+    total = append_episodes_to_corpus(data_dir, [episode])
+    assert total == 5  # numbering continued after the orphans
+    manifest = read_manifest(data_dir)
+    assert manifest["episodes"] == 5  # disk truth, orphans included
+    assert manifest["collected_episodes"] == 2
+    assert manifest["dagger_episodes"] == 3  # 2 orphans + 1 appended
+    # No staging dir left behind.
+    assert not [
+        d for d in os.listdir(os.path.join(data_dir, "train"))
+        if d.startswith(".dagger_stage")
+    ]
 
 
 def test_append_requires_manifest(tmp_path):
