@@ -194,3 +194,36 @@ def append_episodes_to_corpus(data_dir, episodes, split="train"):
     manifest["dagger_episodes"] = manifest["episodes"] - baseline
     write_manifest(data_dir, **manifest)
     return existing + len(episodes)
+
+
+def collect_dagger_batch(
+    env,
+    policy,
+    oracle,
+    num_episodes,
+    rng,
+    max_steps=80,
+    beta=0.0,
+    max_attempts_factor=5,
+):
+    """Collect `num_episodes` relabeled on-policy episodes (failures kept).
+
+    Invalid inits (no collision-free oracle plan) are skipped and
+    re-randomized, bounded by `max_attempts_factor * num_episodes` total
+    attempts so a pathological board distribution cannot spin forever.
+    Returns (episodes, successes, attempts).
+    """
+    episodes, successes, attempts = [], 0, 0
+    while (
+        len(episodes) < num_episodes
+        and attempts < max_attempts_factor * num_episodes
+    ):
+        attempts += 1
+        ep, success = collect_dagger_episode(
+            env, policy, oracle, max_steps=max_steps, beta=beta, rng=rng,
+        )
+        if ep is None:
+            continue
+        episodes.append(ep)
+        successes += int(success)
+    return episodes, successes, attempts

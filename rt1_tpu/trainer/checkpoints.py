@@ -81,3 +81,15 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Newest checkpoint step under `ckpt_dir`, or None — without building a
+    CheckpointManager (cheap enough for CLI glue, watchdogs, and provenance
+    stamping; Orbax step dirs are plain integer-named directories)."""
+    import os
+
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    return max(steps) if steps else None

@@ -1,0 +1,11 @@
+"""Cross-cutting utilities: proof-artifact archiving, training-curve IO."""
+
+from rt1_tpu.utils.artifacts import archive_file, copy_proof_videos
+from rt1_tpu.utils.curves import plot_loss_curves, read_scalar_curves
+
+__all__ = [
+    "archive_file",
+    "copy_proof_videos",
+    "plot_loss_curves",
+    "read_scalar_curves",
+]

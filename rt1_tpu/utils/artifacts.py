@@ -1,0 +1,54 @@
+"""Proof-artifact archiving: copy run outputs into a committable directory
+without ever clobbering an earlier round's record.
+
+Extracted from `scripts/learn_proof.py` (VERDICT r4 weak #7). The
+no-overwrite discipline exists because unattended pipeline runs re-invoke
+stages with the same --run_tag after crashes; a rerun must add a sibling,
+not silently replace committed evidence.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+
+
+def archive_file(src: str, artifacts_dir: str, dest_name: str) -> str | None:
+    """Copy `src` to `<artifacts_dir>/<dest_name>`, uniquifying on conflict
+    (`name-1.ext`, `name-2.ext`, ...). Returns the destination path, or
+    None when `src` does not exist."""
+    if not os.path.exists(src):
+        return None
+    dest = os.path.join(artifacts_dir, dest_name)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    stem, ext = os.path.splitext(dest)
+    n = 1
+    while os.path.exists(dest):
+        dest = f"{stem}-{n}{ext}"
+        n += 1
+    shutil.copy2(src, dest)
+    return dest
+
+
+def copy_proof_videos(video_dir: str, artifacts_dir: str, prefix: str,
+                      max_videos: int = 3) -> list[str]:
+    """Stage up to `max_videos` episode videos (successes preferred) into
+    `<artifacts_dir>/learn_proof_videos/`, prefixed so reruns/rounds never
+    clobber earlier proof records. Returns the archived paths."""
+    if not os.path.isdir(video_dir):
+        return []
+    vids = sorted(glob.glob(os.path.join(video_dir, "*success*"))) + sorted(
+        glob.glob(os.path.join(video_dir, "*failure*"))
+    )
+    out = []
+    for src in vids[:max_videos]:
+        dest = archive_file(
+            src, artifacts_dir,
+            os.path.join(
+                "learn_proof_videos", f"{prefix}_{os.path.basename(src)}"
+            ),
+        )
+        if dest:
+            out.append(dest)
+    return out

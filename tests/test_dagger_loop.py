@@ -1,0 +1,165 @@
+"""Unit tests for the DAgger outer-loop state machine
+(rt1_tpu/train/dagger_loop.py; VERDICT r4 weak #7).
+
+The loop's crash-resume contract previously lived inside
+scripts/learn_proof.py and could only be exercised via subprocess runs;
+these tests drive it directly with fake collect/train callables, including
+kill-and-resume at every transition.
+"""
+
+import os
+
+import pytest
+
+from rt1_tpu.train.dagger_loop import (
+    DaggerLoopConfig,
+    clear_state,
+    round_target_step,
+    run_dagger_loop,
+)
+
+
+class Recorder:
+    """Fake collect/train endpoints that log every call and can be armed to
+    crash at a chosen call index (simulating a host reset)."""
+
+    def __init__(self, crash_train_at=None, crash_collect_at=None):
+        self.collects = []
+        self.trains = []
+        self.crash_train_at = crash_train_at
+        self.crash_collect_at = crash_collect_at
+
+    def collect_round(self, rnd):
+        if self.crash_collect_at == len(self.collects):
+            raise RuntimeError("simulated reset during collection")
+        self.collects.append(rnd)
+        return {"rollout_episodes": 4, "rollout_successes": rnd}
+
+    def train_to(self, target):
+        if self.crash_train_at == len(self.trains):
+            raise RuntimeError("simulated reset during training")
+        self.trains.append(target)
+
+
+def _cfg(rounds=3, extra=500):
+    return DaggerLoopConfig(rounds=rounds, extra_steps=extra)
+
+
+def test_round_target_derives_from_base():
+    assert round_target_step(20000, 0, 2500) == 22500
+    assert round_target_step(20000, 3, 2500) == 30000
+
+
+def test_fresh_run_full_loop(tmp_path):
+    state_path = str(tmp_path / "dagger_state.json")
+    rec = Recorder()
+    history = run_dagger_loop(
+        state_path, base_step=1000, config=_cfg(),
+        collect_round=rec.collect_round, train_to=rec.train_to,
+        log=lambda *_: None,
+    )
+    assert rec.collects == [0, 1, 2]
+    assert rec.trains == [1500, 2000, 2500]
+    assert [h["round"] for h in history] == [0, 1, 2]
+    assert [h["rollout_successes"] for h in history] == [0, 1, 2]
+    # State survives completion: the CALLER deletes it after archiving the
+    # history (a crash before the archive must resume as already-complete,
+    # not re-run the rounds and double-append episodes).
+    assert os.path.exists(state_path)
+    # Re-entering an already-complete loop is an instant no-op replay.
+    rec2 = Recorder()
+    replay = run_dagger_loop(
+        state_path, base_step=0, config=_cfg(),
+        collect_round=rec2.collect_round, train_to=rec2.train_to,
+        log=lambda *_: None,
+    )
+    assert rec2.collects == [] and rec2.trains == []
+    assert [h["round"] for h in replay] == [0, 1, 2]
+    clear_state(state_path)
+    assert not os.path.exists(state_path)
+    clear_state(state_path)  # idempotent
+
+
+def test_crash_during_training_does_not_recollect(tmp_path):
+    state_path = str(tmp_path / "dagger_state.json")
+    rec = Recorder(crash_train_at=1)  # dies inside round 1's extension
+    with pytest.raises(RuntimeError, match="during training"):
+        run_dagger_loop(
+            state_path, base_step=1000, config=_cfg(),
+            collect_round=rec.collect_round, train_to=rec.train_to,
+            log=lambda *_: None,
+        )
+    assert rec.collects == [0, 1]  # round 1 aggregated (phase A durable)
+    assert rec.trains == [1500]
+    assert os.path.exists(state_path)
+
+    # Resume: round 1 must NOT re-aggregate; its training target is
+    # re-derived identically from the recorded base step.
+    rec2 = Recorder()
+    history = run_dagger_loop(
+        state_path, base_step=999999,  # ignored: state's base_step wins
+        config=_cfg(),
+        collect_round=rec2.collect_round, train_to=rec2.train_to,
+        log=lambda *_: None,
+    )
+    assert rec2.collects == [2]  # only the never-aggregated round
+    assert rec2.trains == [2000, 2500]
+    assert [h["round"] for h in history] == [0, 1, 2]
+
+
+def test_crash_during_collection_recollects_that_round(tmp_path):
+    state_path = str(tmp_path / "dagger_state.json")
+    rec = Recorder(crash_collect_at=1)
+    with pytest.raises(RuntimeError, match="during collection"):
+        run_dagger_loop(
+            state_path, base_step=0, config=_cfg(),
+            collect_round=rec.collect_round, train_to=rec.train_to,
+            log=lambda *_: None,
+        )
+    # Round 0 fully completed; round 1's aggregation never became durable,
+    # so the resume runs it again (aggregation itself is the idempotency
+    # boundary — nothing was appended before the crash).
+    rec2 = Recorder()
+    run_dagger_loop(
+        state_path, base_step=0, config=_cfg(),
+        collect_round=rec2.collect_round, train_to=rec2.train_to,
+        log=lambda *_: None,
+    )
+    assert rec2.collects == [1, 2]
+    assert rec2.trains == [1000, 1500]
+
+
+def test_cleared_state_makes_a_fresh_run_rerun_all_rounds(tmp_path):
+    state_path = str(tmp_path / "dagger_state.json")
+    for _ in range(2):
+        rec = Recorder()
+        run_dagger_loop(
+            state_path, base_step=0, config=_cfg(rounds=2),
+            collect_round=rec.collect_round, train_to=rec.train_to,
+            log=lambda *_: None,
+        )
+        # Both invocations run both rounds: the caller-side clear (after
+        # archiving) is what re-arms the workdir for a fresh run.
+        assert rec.collects == [0, 1]
+        clear_state(state_path)
+
+
+def test_history_survives_resume_in_order(tmp_path):
+    state_path = str(tmp_path / "dagger_state.json")
+    rec = Recorder(crash_train_at=0)
+    with pytest.raises(RuntimeError):
+        run_dagger_loop(
+            state_path, base_step=0, config=_cfg(),
+            collect_round=rec.collect_round, train_to=rec.train_to,
+            log=lambda *_: None,
+        )
+    rec2 = Recorder()
+    history = run_dagger_loop(
+        state_path, base_step=0, config=_cfg(),
+        collect_round=rec2.collect_round, train_to=rec2.train_to,
+        log=lambda *_: None,
+    )
+    assert [h["round"] for h in history] == [0, 1, 2]
+    # The resumed history keeps round 0's original entry (successes=0 from
+    # the first Recorder), not a re-collected one.
+    assert history[0]["rollout_successes"] == 0
