@@ -51,6 +51,13 @@ def get_config():
     config.model.moe_aux_weight = 0.01
     config.model.moe_capacity_factor = 2.0
     config.model.moe_ff_dim = ml_collections.config_dict.placeholder(int)
+    # Path to a state-regression-pretrained encoder (train/pretrain_vision
+    # .py::save_encoder) grafted into the tokenizer at initialization — the
+    # hermetic stand-in for the reference's ImageNet-pretrained B3 tower
+    # (film_efficientnet_encoder.py:376-425). None = train from scratch.
+    config.model.pretrained_encoder = ml_collections.config_dict.placeholder(
+        str
+    )
 
     # LAVA family fields (used when family == "lava"; defaults mirror the
     # reference's SequenceLAVMSE config, `train/configs/

@@ -381,6 +381,38 @@ def train_and_evaluate(config, workdir: str):
     )
     rng = jax.random.PRNGKey(config.seed)
     state = create_train_state(model, rng, example, tx, init_fn=init_fn)
+    pretrained_encoder = config.model.get("pretrained_encoder")
+    if pretrained_encoder:
+        from rt1_tpu.trainer.checkpoints import latest_step
+
+        if latest_step(os.path.join(workdir, "checkpoints")) is not None:
+            # Resumed runs (incl. every DAgger extension) restore their
+            # checkpoint immediately below — grafting first would be wasted
+            # work and, worse, a false "grafted" provenance line in the log.
+            pretrained_encoder = None
+    if pretrained_encoder:
+        # Hermetic substitute for the reference's ImageNet-pretrained tower
+        # (film_efficientnet_encoder.py:376-425): graft a state-regression-
+        # pretrained encoder (train/pretrain_vision.py) into the tokenizer
+        # BEFORE restore — a resumed run's checkpoint still wins.
+        from absl import logging
+
+        from rt1_tpu.train.pretrain_vision import (
+            graft_encoder_into_policy,
+            load_encoder,
+        )
+
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        grafted = graft_encoder_into_policy(
+            variables, load_encoder(pretrained_encoder)
+        )
+        state = state.replace(
+            params=grafted["params"],
+            batch_stats=grafted.get("batch_stats", state.batch_stats),
+        )
+        logging.info("grafted pretrained encoder from %s", pretrained_encoder)
     if jax.process_index() == 0:
         log_parameter_overview(
             state.params, os.path.join(workdir, "parameters.txt")

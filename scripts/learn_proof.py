@@ -98,6 +98,12 @@ flags.DEFINE_bool(
     "LR — the round-3 plateau diagnosis showed the decay freezes the "
     "policy before the token CE escapes the marginal (RESULTS.md).")
 flags.DEFINE_string(
+    "pretrained_encoder", "",
+    "Path to a state-regression-pretrained encoder "
+    "(rt1_tpu/train/pretrain_vision.py) grafted into the tokenizer at "
+    "train initialization; empty = from scratch (reference trains from "
+    "ImageNet-pretrained B3 — this is the hermetic substitute).")
+flags.DEFINE_string(
     "run_tag", "r03",
     "Label stamped into the self-archived artifact filenames; pass a fresh "
     "tag per round/run so reruns don't clobber earlier proof records.")
@@ -118,6 +124,8 @@ def get_train_config(data_dir, num_steps, constant_lr=None):
     config.model.focal_gamma = FLAGS.focal_gamma
     config.model.aux_mse_weight = FLAGS.aux_mse_weight
     config.model.dtype = FLAGS.dtype
+    if FLAGS.pretrained_encoder:
+        config.model.pretrained_encoder = FLAGS.pretrained_encoder
     config.data.data_dir = data_dir
     config.data.height = FLAGS.height
     config.data.width = FLAGS.width
@@ -180,7 +188,10 @@ EVAL_META_KEYS = (
 )
 # batch additionally matters when *resuming training* (optimizer/data order),
 # but params are batch-independent, so eval may legitimately differ.
-TRAIN_META_KEYS = EVAL_META_KEYS + ("batch",)
+# pretrained_encoder changes only the init, so eval of an existing
+# checkpoint never needs it to match — but a RESUMED training run does
+# (provenance: which init produced this arm).
+TRAIN_META_KEYS = EVAL_META_KEYS + ("batch", "pretrained_encoder")
 
 
 def _check_train_meta(train_dir, context, keys):
@@ -301,7 +312,10 @@ def stage_dagger(data_dir, train_dir):
     )
     from rt1_tpu.train.train import train_and_evaluate
 
-    _check_train_meta(train_dir, "dagger", EVAL_META_KEYS)
+    # DAgger EXTENDS training, so the full train-identity keys apply
+    # (batch affects optimizer/data order; pretrained_encoder is init
+    # provenance) — not just the eval subset.
+    _check_train_meta(train_dir, "dagger", TRAIN_META_KEYS)
     check_embedder_compatibility(data_dir, FLAGS.embedder, context="dagger")
     # Aggregation must roll out under the corpus' own settings, or the
     # manifest stamps become provenance lies (the failure class the

@@ -45,10 +45,12 @@ pause_cpu_jobs() {
   pkill -STOP -f "learn_proof.py --workdir" 2>/dev/null
   pkill -STOP -f "multiprocessing.spawn import spawn_main" 2>/dev/null
   pkill -STOP -f "capacity_arm" 2>/dev/null
+  pkill -STOP -f "perception_probe" 2>/dev/null
   pkill -STOP -f "pretrain_vision" 2>/dev/null
 }
 resume_cpu_jobs() {
   pkill -CONT -f "pretrain_vision" 2>/dev/null
+  pkill -CONT -f "perception_probe" 2>/dev/null
   pkill -CONT -f "capacity_arm" 2>/dev/null
   pkill -CONT -f "multiprocessing.spawn import spawn_main" 2>/dev/null
   pkill -CONT -f "learn_proof.py --workdir" 2>/dev/null
