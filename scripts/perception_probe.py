@@ -36,6 +36,10 @@ ARMS = [
     ("small_96x160", 0.35, 0.35, (96, 160)),
     ("wide_64x96", 0.70, 0.35, (64, 96)),
     ("small_128x224", 0.35, 0.35, (128, 224)),
+    # Flagship coefficients (B3). CPU-expensive: select explicitly via
+    # --arms (pretraining this one is chip-class work; the graft then
+    # seeds the flagship learn_proof arm via --pretrained_encoder).
+    ("b3_128x224", 1.2, 1.4, (128, 224)),
 ]
 
 
