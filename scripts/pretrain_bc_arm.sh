@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 initialization arm (VERDICT r4 weak #4 / next #3b): BC at the
+# EXACT round-3 DART arm config (seq_len 1, efficientnet_small, 64x96,
+# float32, batch 16, ngram, 7500 steps) but initialized from the
+# state-regression-pretrained encoder instead of scratch — then the same
+# 20-episode diagnostics. Direct comparison point:
+# artifacts/dart_t1_diag_ck7500.json (scratch init, same corpus/config:
+# cosine -0.79, action std 0.0034, 0 successes).
+#
+# Usage: setsid nohup env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+#          bash scripts/pretrain_bc_arm.sh > artifacts/pretrain_bc_arm_r05.log \
+#          2>&1 < /dev/null &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+log() { echo "[bc_arm $(date +%H:%M:%S)] $*"; }
+
+ENC="${ENC:-/root/perception_probe/encoder_small_64x96.msgpack}"
+SEED_CORPUS="${SEED_CORPUS:-/root/learn_proof_dart}"
+WD="${WD:-/root/lp_pretrain_bc}"
+STEPS="${STEPS:-7500}"
+
+# Wait for the probe's first arm to publish the encoder (up to 3 h).
+for i in $(seq 1 180); do
+  [ -f "$ENC" ] && break
+  log "waiting for $ENC ($i)"
+  sleep 60
+done
+[ -f "$ENC" ] || { log "encoder never appeared; aborting"; exit 1; }
+
+if [ ! -d "$WD" ]; then
+  log "seeding $WD from $SEED_CORPUS (hardlinked corpus, fresh train dir)"
+  mkdir -p "$WD"
+  cp -al "$SEED_CORPUS/data" "$WD/data"
+fi
+
+ARGS=(--workdir "$WD" --seq_len 1 --image_tokenizer efficientnet_small
+      --height 64 --width 96 --dtype float32 --batch 16 --embedder ngram
+      --num_steps "$STEPS" --checkpoint_every 2500
+      --pretrained_encoder "$ENC" --run_tag r05pretrainbc)
+
+log "training $STEPS steps from pretrained encoder"
+python scripts/learn_proof.py "${ARGS[@]}" --stage train \
+  || { log "train FAILED rc=$?"; exit 1; }
+
+log "diagnostics (20 episodes)"
+python scripts/policy_diagnostics.py "${ARGS[@]}" --diag_episodes 20 \
+  --out "$REPO/artifacts/pretrain_bc_diag_ck${STEPS}.json" \
+  || log "diagnostics rc=$?"
+
+log "standard eval (trained/random/oracle)"
+python scripts/learn_proof.py "${ARGS[@]}" --stage eval \
+  || log "eval rc=$?"
+
+touch "$WD/bc_arm_done"
+log "complete"
