@@ -117,3 +117,22 @@ def test_pretrain_model_head_shape():
     )
     out = model.apply(variables, jnp.zeros((2, 32, 56, 3)), train=False)
     assert out.shape == (2, 10)
+
+
+def test_generate_state_regression_dataset_contract():
+    from rt1_tpu.train.pretrain_vision import (
+        generate_state_regression_dataset,
+    )
+
+    images, targets, names = generate_state_regression_dataset(
+        6, seed=3, image_hw=(32, 56), random_steps=2,
+    )
+    assert images.shape == (6, 32, 56, 3) and images.dtype == np.uint8
+    # BLOCK_4 board: effector xy + 4 block xy pairs.
+    assert targets.shape == (6, 10) and targets.dtype == np.float32
+    assert np.all(np.isfinite(targets))
+    assert names[:2] == ["effector_x", "effector_y"]
+    assert len(names) == targets.shape[1]
+    # Targets vary across frames (the board is re-randomized) — a constant
+    # target column would make the regression degenerate.
+    assert np.std(targets, axis=0).min() > 0
