@@ -24,6 +24,9 @@ import os
 import sys
 import time
 
+# Line-buffer stdout so detached runs show live progress in their log.
+sys.stdout.reconfigure(line_buffering=True)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
