@@ -21,13 +21,51 @@
 #     moment the host comes back" watcher VERDICT asked for.
 #
 # Usage: setsid nohup bash scripts/round5_pipeline.sh \
-#            > artifacts/pipeline_r05.log 2>&1 < /dev/null &
+#            >> artifacts/pipeline_r05.log 2>&1 < /dev/null &
+# (append, not truncate: a relaunch bounced by the singleton guard must
+# not wipe the live instance's log history)
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
 log() { echo "[pipeline $(date +%H:%M:%S)] $*"; }
 
+# Singleton guard: two concurrent instances fight over SIGSTOP/SIGCONT of
+# the CPU jobs (one pauses for its uncontended bench window, the other
+# resumes 300 s later), silently invalidating "uncontended" numbers.
+PIDFILE="$REPO/.round5_pipeline.pid"
+BOOT_ID=$(cat /proc/sys/kernel/random/boot_id 2>/dev/null || echo unknown)
+# The pidfile survives host resets (it lives in the repo) while the
+# process does not — a recorded pid counts as a live holder only when it
+# is from THIS boot, alive, and actually running this script (pid reuse
+# across or within boots must not block the reset-recovery launch).
+pidfile_holder() {
+  local oldpid oldboot
+  read -r oldpid oldboot < "$PIDFILE" 2>/dev/null || return 1
+  [ -n "${oldpid:-}" ] && [ "${oldboot:-}" = "$BOOT_ID" ] \
+    && kill -0 "$oldpid" 2>/dev/null \
+    && grep -aq round5_pipeline "/proc/$oldpid/cmdline" 2>/dev/null \
+    || return 1
+  echo "$oldpid"
+}
+# Atomic create (noclobber) closes the check-then-write race between two
+# simultaneous launches; one stale-file removal retry handles leftovers.
+for _try in 1 2; do
+  if (set -o noclobber; echo "$$ $BOOT_ID" > "$PIDFILE") 2>/dev/null; then
+    break
+  fi
+  if holder=$(pidfile_holder); then
+    log "another pipeline instance (pid $holder) is running; exiting"
+    exit 0
+  fi
+  rm -f "$PIDFILE"
+  [ "$_try" = 2 ] && { log "pidfile contention; exiting"; exit 0; }
+done
+# Only remove the pidfile we own, and never exit leaving CPU jobs frozen
+# by a pause window this instance opened.
+trap '[ "$(cut -d" " -f1 "$PIDFILE" 2>/dev/null)" = "$$" ] && rm -f "$PIDFILE"; resume_cpu_jobs' EXIT
+
 DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
+DART_NOISE="${DART_NOISE:-0.005}"
 OUT="TPU_VALIDATION_r05.json"
 RELAY_HOST=127.0.0.1
 RELAY_PORT=2024
@@ -166,15 +204,131 @@ log "round-5 pipeline up; deadline $(date -d "@$DEADLINE_EPOCH" +%H:%M:%S)"
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python -m rt1_tpu.chip_claim status || true
 
+# ---- stage 0b: flagship DART corpus (re-)collection (background, CPU) ----
+# Host resets wipe /root outside the repo (round-3 and round-5 records), so
+# the 400-episode corpus may need re-collecting from scratch. Collection is
+# SIGSTOPped by pause_cpu_jobs during the uncontended bench window.
+COLLECT_PAT=$(printf '%s' \
+  "learn_proof.py --workdir $DART_CORPUS --stage collect" \
+  | sed 's/[][\\.*^$()+?{}|]/\\&/g')
+collector_alive() { pgrep -f "$COLLECT_PAT" > /dev/null; }
+launch_collector() {
+  log "launching flagship DART collection (400 eps, noise $DART_NOISE)"
+  mkdir -p "$DART_CORPUS"
+  setsid nohup env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python scripts/learn_proof.py --workdir "$DART_CORPUS" --stage collect \
+    --episodes 400 --workers 2 --exec_noise_std "$DART_NOISE" \
+    --embedder ngram \
+    >> artifacts/collect_dart_flagship_r05.log 2>&1 < /dev/null &
+}
+# Spawn workers outlive a killed parent and keep writing _shards/
+# (rt1_tpu/data/collect.py::finalize_shards docstring). Reaping must be
+# scoped: only ORPHANS (ppid 1, a live parent's join() would crash), and
+# only ones provably writing THIS corpus (an open fd under $DART_CORPUS)
+# — other arms' orphan workers are banking shards for their own salvage.
+flagship_orphan_spawn_workers() {
+  local p
+  for p in $(pgrep -f "multiprocessing.spawn import spawn_main"); do
+    [ "$(ps -o ppid= -p "$p" 2>/dev/null | tr -d ' ')" = 1 ] || continue
+    if ls -l "/proc/$p/fd" 2>/dev/null | grep -q -- "$DART_CORPUS"; then
+      echo "$p"
+    fi
+  done
+}
+any_orphan_spawn_workers() {
+  local p
+  for p in $(pgrep -f "multiprocessing.spawn import spawn_main"); do
+    [ "$(ps -o ppid= -p "$p" 2>/dev/null | tr -d ' ')" = 1 ] && return 0
+  done
+  return 1
+}
+kill_orphan_spawn_workers() {
+  local p killed=0
+  for p in $(flagship_orphan_spawn_workers); do
+    kill -INT "$p" 2>/dev/null && killed=1
+  done
+  [ "$killed" = 1 ] && sleep 10
+  for p in $(flagship_orphan_spawn_workers); do
+    kill -TERM "$p" 2>/dev/null
+  done
+  [ "$killed" = 1 ] && sleep 2
+}
+collect_relaunches=0
+LAST_SHARDS=-1
+ORPHAN_DEFERS=0
+# Shared by stage 0b and the stage-2 wait loop. Returns 0 when the corpus
+# is complete (manifest present, possibly via shard salvage), 1 while a
+# collector is running or was (re)launched, 2 when giving up. NEVER
+# relaunches over salvageable shards: collect_dataset_parallel rmtree's
+# _shards/ on start, so >=300 banked episodes are dealt instead.
+recover_collector() {
+  [ -f "$DART_CORPUS/data/manifest.json" ] && return 0
+  collector_alive && return 1
+  local shards
+  shards=$(find "$DART_CORPUS/data/_shards" -name '*.npz' 2>/dev/null \
+           | wc -l)
+  # Defer BEFORE reaping: orphan workers that are still banking episodes
+  # (shard count moving, or no stable baseline yet — first call has
+  # LAST_SHARDS=-1) should be left to finish, not killed. The fd scan
+  # alone can miss a writer between file opens, so growth is the proof.
+  # Bounded (ORPHAN_DEFERS) so a stuck foreign orphan can't block
+  # recovery until the deadline.
+  if any_orphan_spawn_workers \
+     && [ "$shards" != "$LAST_SHARDS" ] && [ "$ORPHAN_DEFERS" -lt 4 ]; then
+    ORPHAN_DEFERS=$((ORPHAN_DEFERS + 1))
+    log "orphan workers present, shards $LAST_SHARDS -> $shards —" \
+        "deferring ($ORPHAN_DEFERS/4)"
+    LAST_SHARDS=$shards
+    return 1
+  fi
+  LAST_SHARDS=$shards
+  # Stable count (or defer budget spent): remaining flagship orphans are
+  # idle or stuck — reap them before any destructive path.
+  kill_orphan_spawn_workers
+  if [ "$shards" -ge 300 ]; then
+    log "collector dead with $shards shard episodes — salvaging deal"
+    if env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<EOF
+import sys; sys.path.insert(0, ".")
+from rt1_tpu.data.collect import finalize_shards
+print(finalize_shards("$DART_CORPUS/data", embedder="ngram",
+                      reward="block2block", block_mode="BLOCK_4",
+                      max_steps=80, image_hw=None, workers=2, seed=0,
+                      exec_noise_std=$DART_NOISE))
+EOF
+    then return 0; fi
+    # Do NOT fall through to a relaunch: collect_dataset_parallel wipes
+    # _shards/ on start, and a persistent salvage refusal (e.g. a split
+    # dir left non-empty by a crashed deal) would burn every relaunch
+    # slot destroying the same banked episodes. Hold for an operator.
+    log "salvage failed with $shards banked episodes — NOT relaunching;" \
+        "inspect $DART_CORPUS/data manually"
+    return 2
+  fi
+  if [ "$collect_relaunches" -ge 3 ]; then
+    log "collector dead after $collect_relaunches relaunches; giving up"
+    return 2
+  fi
+  collect_relaunches=$((collect_relaunches + 1))
+  log "collector not running ($shards shard eps) — launch $collect_relaunches"
+  launch_collector
+  return 1
+}
+recover_collector || true
+
 # ---- stage 1: bench matrix, watched quiet-gap loop ----
 bench_ok=0
 attempt=0
 healthy_attempts=0
-if bench_complete; then
-  log "bench matrix already recorded ($OUT)"
+record_bench_done() {
+  bench_complete || return 1
+  log "bench matrix complete ($OUT)"
+  merge_baseline || true
   bench_ok=1
-fi
+}
 while [ "$bench_ok" = 0 ] && ! past_deadline; do
+  # An earlier pipeline instance (or a concurrent tpu_validation) may
+  # finish the matrix while this one is gap-waiting — re-check first.
+  record_bench_done && break
   attempt=$((attempt + 1))
   log "chip probe, attempt $attempt"
   rc=0; probe_chip || rc=$?
@@ -185,12 +339,7 @@ while [ "$bench_ok" = 0 ] && ! past_deadline; do
     RT1_WAIT_MAX_PROBES=2 python scripts/tpu_validation.py --out "$OUT" \
       || log "tpu_validation exited rc=$?"
     resume_cpu_jobs
-    if bench_complete; then
-      log "bench matrix complete ($OUT)"
-      merge_baseline || true
-      bench_ok=1
-      break
-    fi
+    record_bench_done && break
     if [ "$healthy_attempts" -ge 3 ]; then
       log "matrix incomplete after $healthy_attempts healthy attempts;" \
           "accepting partial record and moving on"
@@ -200,19 +349,44 @@ while [ "$bench_ok" = 0 ] && ! past_deadline; do
     log "bench matrix incomplete after a healthy probe; short gap 600s"
     sleep 600
   elif [ "$rc" = 2 ]; then
+    # Another claimant (possibly a bench) is live — do NOT resume CPU
+    # jobs here, it could contend an uncontended measurement window.
     log "claim lock held by another job; short gap 300s"
     sleep 300
   else
+    # Wedged chip: nothing TPU-shaped can run, so let the CPU jobs (a
+    # SIGSTOPped collector inherited from a killed instance's pause
+    # window, probe arms) make progress through the quiet gap.
+    resume_cpu_jobs
     log "chip not claimable (probe rc=$rc); watched quiet gap 3600s"
     watch_gap 3600
   fi
 done
+# Covers starting (or restarting) past the deadline with a matrix an
+# earlier instance already completed: the loop body never ran.
+[ "$bench_ok" = 0 ] && record_bench_done
 [ "$bench_ok" = 1 ] || log "bench matrix NOT recorded before deadline"
 
 # ---- stage 2: flagship DART learning arm on the chip ----
+# Stage 1 may have exited on a fast path (matrix already complete, or
+# rc=2 until deadline) that never ran resume_cpu_jobs — a collector
+# frozen by a killed instance's pause window must not stay frozen here.
+resume_cpu_jobs
 fail=0
 FLAG_ARGS=(--workdir "$DART_CORPUS" --seq_len 1 --batch 32 --constant_lr
            --embedder ngram --num_steps 50000 --run_tag r05flag)
+# Collection may still be running (stage 0b relaunches it after a host
+# reset wipes the corpus) — wait for the manifest rather than skip. A
+# crashed collector is salvaged or relaunched (bounded); a collector left
+# SIGSTOPped by a killed previous pipeline instance is resumed.
+while [ ! -f "$DART_CORPUS/data/manifest.json" ] && ! past_deadline; do
+  resume_cpu_jobs
+  rc=0; recover_collector || rc=$?
+  [ "$rc" = 0 ] && break
+  [ "$rc" = 2 ] && break
+  log "waiting for flagship corpus (collector running)"
+  sleep 300
+done
 if [ -f "$DART_CORPUS/data/manifest.json" ]; then
   train_ok=0
   for attempt in $(seq 1 24); do
