@@ -1,0 +1,60 @@
+"""Training-config assembly for the learning-proof arms.
+
+Extracted from ``scripts/learn_proof.py`` (VERDICT r4 next #7) so the
+LR-schedule placement logic is unit-testable without absl FLAGS.
+"""
+
+from __future__ import annotations
+
+
+def proof_train_config(
+    data_dir: str,
+    num_steps: int,
+    *,
+    image_tokenizer: str = "efficientnet_b3",
+    seq_len: int = 6,
+    focal_gamma: float = 0.0,
+    aux_mse_weight: float = 0.0,
+    dtype: str = "bfloat16",
+    pretrained_encoder: str = "",
+    height: int = 128,
+    width: int = 224,
+    batch: int = 32,
+    checkpoint_every: int = 2500,
+    constant_lr: bool = False,
+):
+    """The flagship/CPU learning-proof config on top of the standard
+    language-table config (reference schedule shape:
+    ``/root/reference/distribute_train.py:283-287``).
+
+    MultiStepLR milestones (50, 75, 90) "epochs" -> decay at 50/75/90% of
+    the run. ``max(1, ...)``: ``steps_per_epoch=0`` would collapse every
+    milestone to boundary 0 and train the whole run at the final decayed
+    LR. ``constant_lr`` pushes every boundary past the horizon instead —
+    the round-4 recipe for DART/DAgger arms whose data distribution
+    shifts late in the run.
+    """
+    from rt1_tpu.train.configs import language_table
+
+    config = language_table.get_config()
+    config.model.image_tokenizer = image_tokenizer
+    config.model.time_sequence_length = seq_len
+    config.model.focal_gamma = focal_gamma
+    config.model.aux_mse_weight = aux_mse_weight
+    config.model.dtype = dtype
+    if pretrained_encoder:
+        config.model.pretrained_encoder = pretrained_encoder
+    config.data.data_dir = data_dir
+    config.data.height = height
+    config.data.width = width
+    config.per_host_batch_size = batch
+    config.num_steps = num_steps
+    config.steps_per_epoch = (
+        num_steps * 100 if constant_lr else max(1, num_steps // 100)
+    )
+    config.checkpoint_every_steps = checkpoint_every
+    config.keep_period = 10000
+    config.log_every_steps = 50
+    config.eval_every_steps = 1000
+    config.eval_batches = 4
+    return config

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 perception-capacity arms (VERDICT r4 next #3), relaunched after
+# the host reset killed the originals. Waits for the flagship DART corpus
+# (same recipe as the wiped round-3 corpus: 400 eps, noise 0.005, ngram,
+# BLOCK_4 — so it seeds BOTH the flagship chip arm and this CPU arm),
+# then launches, niced so the flagship train's host feed wins the core:
+#   a. scripts/perception_probe.py — capacity/resolution RMSE floors +
+#      pretrained encoders (arms small_64x96, small_96x160, wide_64x96,
+#      small_128x224).
+#   b. scripts/pretrain_bc_arm.sh — BC at the round-3 config from the
+#      small_64x96 pretrained encoder (vs artifacts/dart_t1_diag_ck7500
+#      scratch baseline).
+#
+# Usage: setsid nohup bash scripts/probe_arms_r05.sh \
+#            >> artifacts/probe_arms_r05.log 2>&1 < /dev/null &
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+log() { echo "[probe_arms $(date +%H:%M:%S)] $*"; }
+
+DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
+PROBE_OUT="${PROBE_OUT:-/root/perception_probe}"
+
+log "waiting for flagship corpus manifest"
+while [ ! -f "$DART_CORPUS/data/manifest.json" ]; do sleep 120; done
+log "corpus ready — launching probe + BC arm (niced)"
+
+if ! pgrep -f "perception_probe.py" > /dev/null; then
+  setsid nohup nice -n 10 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python scripts/perception_probe.py --out_dir "$PROBE_OUT" \
+    --frames 10000 --steps 2500 \
+    --arms small_64x96,small_96x160,wide_64x96,small_128x224 \
+    >> artifacts/perception_probe_r05.log 2>&1 < /dev/null &
+fi
+
+if ! pgrep -f "pretrain_bc_arm.sh" > /dev/null; then
+  setsid nohup nice -n 10 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    SEED_CORPUS="$DART_CORPUS" \
+    bash scripts/pretrain_bc_arm.sh \
+    >> artifacts/pretrain_bc_arm_r05.log 2>&1 < /dev/null &
+fi
+log "launched; done"

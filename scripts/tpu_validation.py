@@ -260,7 +260,14 @@ def main():
                 wait_for_chip()
 
         for impl in ("dense", "pallas"):
-            headline, _ = run_bench("infer", ["--attention_impl", impl])
+            # Pallas first-run on this chip (round 5) sat >50 min in a
+            # remote Mosaic compile with ~zero client CPU; cap the mode at
+            # 900 s (a healthy first compile is 20-40 s) so matrix retries
+            # don't burn an hour per attempt on a known hang.
+            headline, _ = run_bench(
+                "infer", ["--attention_impl", impl],
+                timeout=900 if impl == "pallas" else 3600,
+            )
             results[f"bench_infer_{impl}"] = headline
             print("infer", impl, "->", headline, flush=True)
             checkpoint_results()

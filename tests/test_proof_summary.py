@@ -1,0 +1,130 @@
+"""Unit tests for rt1_tpu/eval/proof.py (extracted from learn_proof.py,
+VERDICT r4 next #7): the pre-registered success criterion, headline
+powering rule, and flag-vs-reality provenance — no subprocess runs.
+"""
+
+import json
+import os
+
+import pytest
+
+from rt1_tpu.eval.proof import (
+    MIN_EPISODES_FOR_SUCCESS_HEADLINE,
+    build_proof_summary,
+    criterion_met,
+    write_proof_json,
+)
+
+REWARD = "block2block"
+
+
+def _results(successes, mean_len=40.0):
+    return {
+        "successes": {REWARD: successes},
+        "mean_episode_length": {REWARD: mean_len},
+    }
+
+
+def _summary(**overrides):
+    kwargs = dict(
+        reward=REWARD,
+        block_mode="BLOCK_4",
+        manifest={"embedder": "ngram", "exec_noise_std": 0.005},
+        flag_embedder="hash",  # deliberately different from the manifest
+        flag_exec_noise_std=0.25,  # deliberately different
+        episodes_collected=400,
+        split_counts={"train": 390, "val": 5, "test": 5},
+        num_steps_requested=50_000,
+        evaluated_checkpoint_step=65_000,  # post-DAgger: != requested
+        seq_len=1,
+        focal_gamma=0.0,
+        aux_mse_weight=0.0,
+        image_tokenizer="efficientnet_b3",
+        resolution=[128, 224],
+        eval_episodes=20,
+        eval_seed=10_000,
+        trained=_results(6),
+        random_results=_results(0),
+        oracle_results=_results(10),
+        curves={"loss": [(0, 3.2), (100, 0.9)], "eval_loss": []},
+    )
+    kwargs.update(overrides)
+    return build_proof_summary(**kwargs)
+
+
+class TestCriterion:
+    def test_half_oracle_bar(self):
+        assert criterion_met(5, 10)
+        assert not criterion_met(4, 10)
+
+    def test_zero_oracle_floor_is_one(self):
+        # max(1, 0 // 2): a dead-oracle protocol still demands >= 1 success.
+        assert not criterion_met(0, 0)
+        assert criterion_met(1, 0)
+
+    def test_odd_oracle_rounds_down(self):
+        assert criterion_met(4, 9)  # 9 // 2 == 4
+        assert not criterion_met(3, 9)
+
+
+class TestHeadlineProtocol:
+    def test_met_but_underpowered_is_not_headline_eligible(self):
+        s = _summary(trained=_results(6), eval_episodes=20)
+        assert s["criterion_met"]
+        assert not s["headline_protocol"]["headline_eligible"]
+
+    def test_met_and_powered_is_eligible(self):
+        s = _summary(
+            trained=_results(26),
+            oracle_results=_results(25),
+            eval_episodes=MIN_EPISODES_FOR_SUCCESS_HEADLINE,
+        )
+        assert s["criterion_met"]
+        assert s["headline_protocol"]["headline_eligible"]
+
+    def test_unmet_is_never_eligible_even_powered(self):
+        s = _summary(trained=_results(0), eval_episodes=80)
+        assert not s["criterion_met"]
+        assert not s["headline_protocol"]["headline_eligible"]
+
+
+class TestProvenance:
+    def test_manifest_beats_flags(self):
+        # The eval stage never collects: corpus facts come from the
+        # manifest, not from whatever flags the eval was invoked with.
+        s = _summary()
+        assert s["embedder"] == "ngram"
+        assert s["exec_noise_std"] == 0.005
+
+
+    def test_missing_manifest_falls_back_to_flags(self):
+        s = _summary(manifest=None)
+        assert s["embedder"] == "hash"
+        assert s["exec_noise_std"] == 0.25
+
+    def test_pre_dart_manifest_means_clean_corpus_not_flag(self):
+        # Manifest exists but predates DART (no exec_noise_std key): the
+        # corpus was collected with zero noise — the eval flag must not
+        # be recorded in its place.
+        s = _summary(manifest={"embedder": "ngram"})
+        assert s["exec_noise_std"] == 0.0
+
+    def test_evaluated_step_is_recorded_beside_requested(self):
+        # ADVICE r4: after DAgger the checkpoint sits past num_steps.
+        s = _summary()
+        assert s["train_steps_requested"] == 50_000
+        assert s["evaluated_checkpoint_step"] == 65_000
+
+    def test_loss_tails(self):
+        s = _summary()
+        assert s["final_train_loss"] == 0.9
+        assert s["final_eval_loss"] is None
+
+
+class TestWriteProofJson:
+    def test_durable_write_and_roundtrip(self, tmp_path):
+        s = _summary()
+        path = write_proof_json(str(tmp_path), s)
+        assert os.path.basename(path) == "learn_proof.json"
+        assert not os.path.exists(path + ".tmp")
+        assert json.load(open(path)) == json.loads(json.dumps(s))

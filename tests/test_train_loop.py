@@ -313,17 +313,12 @@ def test_learn_proof_constant_lr_pushes_milestones_past_horizon():
     """--constant_lr (round-4 recipe: full LR for >=50k steps) must place
     every MultiStepLR boundary beyond the training horizon, while the
     default keeps the reference's 50/75/90% decay shape."""
-    import sys
+    from rt1_tpu.train.proof_config import proof_train_config
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
-    import learn_proof
-
-    if not learn_proof.FLAGS.is_parsed():
-        learn_proof.FLAGS(["learn_proof"])
     num_steps = 1000
-    const = learn_proof.get_train_config("/tmp/x", num_steps, constant_lr=True)
+    const = proof_train_config("/tmp/x", num_steps, constant_lr=True)
     assert min(const.lr_milestones) * const.steps_per_epoch > num_steps
-    decay = learn_proof.get_train_config("/tmp/x", num_steps, constant_lr=False)
+    decay = proof_train_config("/tmp/x", num_steps, constant_lr=False)
     boundaries = [m * decay.steps_per_epoch for m in decay.lr_milestones]
     assert boundaries == [500, 750, 900]
 
