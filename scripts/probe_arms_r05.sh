@@ -21,17 +21,21 @@ log() { echo "[probe_arms $(date +%H:%M:%S)] $*"; }
 DART_CORPUS="${DART_CORPUS:-/root/learn_proof_dart_flagship}"
 PROBE_OUT="${PROBE_OUT:-/root/perception_probe}"
 
-log "waiting for flagship corpus manifest"
-while [ ! -f "$DART_CORPUS/data/manifest.json" ]; do sleep 120; done
-log "corpus ready — launching probe + BC arm (niced)"
-
+# The perception probe is corpus-independent (it renders its own frames)
+# — start it immediately, niced so collection/flagship host feed win the
+# core. Only the BC arm needs the corpus (and the probe's encoder).
 if ! pgrep -f "perception_probe.py" > /dev/null; then
+  log "launching perception probe (niced)"
   setsid nohup nice -n 10 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python scripts/perception_probe.py --out_dir "$PROBE_OUT" \
     --frames 10000 --steps 2500 \
     --arms small_64x96,small_96x160,wide_64x96,small_128x224 \
     >> artifacts/perception_probe_r05.log 2>&1 < /dev/null &
 fi
+
+log "waiting for flagship corpus manifest (BC arm gate)"
+while [ ! -f "$DART_CORPUS/data/manifest.json" ]; do sleep 120; done
+log "corpus ready — launching BC arm (niced)"
 
 if ! pgrep -f "pretrain_bc_arm.sh" > /dev/null; then
   setsid nohup nice -n 10 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

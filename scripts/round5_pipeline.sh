@@ -330,6 +330,9 @@ while [ "$bench_ok" = 0 ] && ! past_deadline; do
   # finish the matrix while this one is gap-waiting — re-check first.
   record_bench_done && break
   attempt=$((attempt + 1))
+  # CPU jobs need not sit frozen through the probe: a wedged probe burns
+  # ~25 min, and the healthy path re-pauses below before any measurement.
+  resume_cpu_jobs
   log "chip probe, attempt $attempt"
   rc=0; probe_chip || rc=$?
   if [ "$rc" = 0 ]; then
