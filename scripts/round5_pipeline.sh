@@ -94,6 +94,19 @@ resume_cpu_jobs() {
   pkill -CONT -f "learn_proof.py --workdir" 2>/dev/null
 }
 
+# Narrow variant for the flagship train/dagger phases: the chip's host
+# feed is CPU-hungry (78% input stall on this 1-core host), so the CPU
+# arms yield — but the broad patterns above would SIGSTOP the flagship's
+# own learn_proof process, so only sibling-arm paths are matched here.
+pause_cpu_arms_narrow() {
+  pkill -STOP -f "perception_probe" 2>/dev/null
+  pkill -STOP -f "workdir /root/lp_pretrain_bc" 2>/dev/null
+}
+resume_cpu_arms_narrow() {
+  pkill -CONT -f "workdir /root/lp_pretrain_bc" 2>/dev/null
+  pkill -CONT -f "perception_probe" 2>/dev/null
+}
+
 probe_chip() {
   # rc 0 = claimable now; 1 = claim failed (wedge); 2 = lock held;
   # 3 = probe still waiting after 35 min (wedge; child left dangling WITH
@@ -434,7 +447,9 @@ if [ -f "$DART_CORPUS/data/manifest.json" ]; then
     fi
     log "flagship train attempt $attempt (50k steps, B3 128x224, full LR)"
     rc=0
+    pause_cpu_arms_narrow
     python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage train || rc=$?
+    resume_cpu_arms_narrow
     if [ "$rc" = 0 ]; then train_ok=1; break; fi
     log "train attempt $attempt rc=$rc; gap 1800s"
     sleep 1800
@@ -473,8 +488,10 @@ EOF
       || log "diagnostics rc=$?"
     if [ "$train_ok" = 1 ] && ! past_deadline; then
       log "flagship on-chip DAgger from ck${latest}"
+      pause_cpu_arms_narrow
       python scripts/learn_proof.py "${FLAG_ARGS[@]}" --stage dagger \
         || log "dagger rc=$?"
+      resume_cpu_arms_narrow
     fi
   else
     log "flagship arm produced NO checkpoint"
