@@ -446,6 +446,13 @@ if [ -f "$DART_CORPUS/data/manifest.json" ]; then
     # Train only fires when a probe says the chip is healthy; a wedged
     # claim inside learn_proof would burn a 25-min failure per attempt.
     rc=0; probe_chip || rc=$?
+    if [ "$rc" = 2 ]; then
+      # Lock held (often a restart-orphaned probe finishing its budget)
+      # — transient, retry shortly rather than burning an hour.
+      log "flagship train: claim lock held; short gap 300s"
+      sleep 300
+      continue
+    fi
     if [ "$rc" != 0 ]; then
       log "flagship train: chip not claimable (rc=$rc); watched gap 3600s"
       watch_gap 3600
