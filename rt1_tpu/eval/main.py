@@ -11,7 +11,6 @@ Run:
 from __future__ import annotations
 
 import json
-import os
 
 
 def load_policy_from_workdir(config, workdir):
@@ -19,52 +18,16 @@ def load_policy_from_workdir(config, workdir):
     policy — RT-1 (`RT1EvalPolicy`, rolling network state) or LAVA
     (`LavaEvalPolicy`, history-window forward; reference Stack B
     `eval/main.py:54-145`) per `config.model.family`."""
-    import jax
-    import numpy as np
-
     from rt1_tpu.eval.policy import LavaEvalPolicy, RT1EvalPolicy
-    from rt1_tpu.specs import language_table_action_space, sample_space
-    from rt1_tpu.train.train import build_family
-    from rt1_tpu.trainer import create_train_state, make_optimizer
-    from rt1_tpu.trainer.checkpoints import (
-        CheckpointConfig,
-        CheckpointManager,
-    )
+    from rt1_tpu.eval.restore import restore_variables
 
-    model, init_fn, _ = build_family(config.model)
-    rng = jax.random.PRNGKey(0)
+    # restore_variables raises FileNotFoundError on an empty workdir —
+    # evaluating randomly initialized weights silently would be worse
+    # than failing.
+    model, variables, step, family, lava_clip = restore_variables(
+        config, workdir
+    )
     t = config.model.time_sequence_length
-    h, w = config.data.height, config.data.width
-    obs = {
-        "image": np.zeros((1, t, h, w, 3), np.float32),
-        "natural_language_embedding": np.zeros((1, t, 512), np.float32),
-    }
-    family = config.model.get("family", "rt1")
-    lava_clip = (
-        family == "lava" and config.model.lava.lang_encoder == "clip"
-    )
-    if lava_clip:
-        obs["instruction_tokenized_clip"] = np.zeros(
-            (1, t, config.model.lava.get("text_context", 77)), np.int32
-        )
-    actions = sample_space(
-        language_table_action_space(), jax.random.fold_in(rng, 1), (1, t)
-    )
-    state = create_train_state(
-        model, rng, (obs, actions), make_optimizer(), init_fn=init_fn
-    )
-    ckpt = CheckpointManager(
-        CheckpointConfig(
-            directory=os.path.join(os.path.abspath(workdir), "checkpoints")
-        )
-    )
-    # restore() raises FileNotFoundError on an empty workdir — evaluating
-    # randomly initialized weights silently would be worse than failing.
-    state = ckpt.restore(state)
-    step = ckpt.latest_step()
-    variables = {"params": state.params}
-    if state.batch_stats:
-        variables["batch_stats"] = state.batch_stats
     # The history keys the policy's observation contract requires — kept
     # here, next to the policy construction, so env setup can't drift.
     history_keys = None  # evaluate.build_eval_env default
@@ -92,8 +55,14 @@ def main(argv):
     del argv
     from absl import flags
 
+    from rt1_tpu import compilation_cache
     from rt1_tpu.envs import blocks
     from rt1_tpu.eval.evaluate import evaluate_policy
+
+    # Persistent XLA cache (same setup as bench.py / __graft_entry__.py):
+    # checkpoint evals re-run per round, but the jitted infer_step only
+    # changes when the model config does — later runs skip the compile.
+    compilation_cache.enable_persistent_cache()
 
     FLAGS = flags.FLAGS
     config = FLAGS.config

@@ -11,9 +11,13 @@ reference's tokens_per_action full passes), observations are padded to
 fixed shapes so there is exactly one compile, and the network state is
 donated to avoid a device copy per step (SURVEY.md §7 hard part 3 — the
 10 Hz control loop budget).
-"""
 
-import functools
+The jitted step itself lives in `rt1_tpu/serve/engine.py:PolicyEngine` —
+the serving layer's multi-session batched engine. `RT1EvalPolicy` is its
+single-slot wrapper: same donated-state semantics, same one-compile
+contract (AOT-lowered), with the eval harness's observation unpacking and
+action de-normalization on top.
+"""
 
 import numpy as np
 
@@ -22,6 +26,8 @@ EPS = np.finfo(np.float32).eps
 
 class RT1EvalPolicy:
     """Closed-loop policy bridging env observations to the jitted model."""
+
+    _SESSION = "eval"
 
     def __init__(
         self,
@@ -32,42 +38,62 @@ class RT1EvalPolicy:
         action_minimum=-0.03,
         action_maximum=0.03,
     ):
-        import jax
+        from rt1_tpu.serve.engine import PolicyEngine
 
-        self._model = model
-        self._variables = variables
-        self.action_mean = action_mean
-        self.action_std = action_std
-        self.action_minimum = action_minimum
-        self.action_maximum = action_maximum
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def _step(observation, state):
-            return model.apply(
-                variables, observation, state, method=model.infer_step
-            )
-
-        self._step = _step
-        self.network_state = None
+        self._engine = PolicyEngine(
+            model,
+            variables,
+            max_sessions=1,
+            action_mean=action_mean,
+            action_std=action_std,
+            action_minimum=action_minimum,
+            action_maximum=action_maximum,
+        )
         self.reset()
+
+    # De-normalization now lives in the engine; read-only views keep the
+    # old attribute API without a silently-ignored mutable copy.
+    @property
+    def action_mean(self):
+        return self._engine.action_mean
+
+    @property
+    def action_std(self):
+        return self._engine.action_std
+
+    @property
+    def action_minimum(self):
+        return self._engine.action_minimum
+
+    @property
+    def action_maximum(self):
+        return self._engine.action_maximum
 
     def reset(self):
         """Zero the rolling window (reference `main_rt1.py:158-160`)."""
-        self.network_state = self._model.initial_state(batch_size=1)
+        self._engine.reset(self._SESSION)
+
+    @property
+    def network_state(self):
+        """The session's rolling state, unbatched and on host (diagnostics;
+        the live state stays donated on device inside the engine)."""
+        return self._engine.session_state(self._SESSION)
 
     def action(self, observation):
         """One control step. `observation` is the history-stacked obs dict;
         only the last frame is consumed (reference `policy.py:65-66`)."""
-        image = observation["rgb_sequence"][-1][None]  # (1, H, W, 3)
-        embedding = observation["natural_language_embedding"][-1][None]
-        model_obs = {
-            "image": image.astype(np.float32),
-            "natural_language_embedding": embedding.astype(np.float32),
-        }
-        output, self.network_state = self._step(model_obs, self.network_state)
-        action = np.asarray(output["action"][0])
-        action = action * max(self.action_std, EPS) + self.action_mean
-        return np.clip(action, self.action_minimum, self.action_maximum)
+        output = self._engine.act(
+            self._SESSION,
+            {
+                "image": np.asarray(
+                    observation["rgb_sequence"][-1], np.float32
+                ),
+                "natural_language_embedding": np.asarray(
+                    observation["natural_language_embedding"][-1], np.float32
+                ),
+            },
+        )
+        return output["action"]
 
 
 class LavaEvalPolicy:

@@ -1,18 +1,106 @@
-"""Restore a closed-loop eval policy from a training workdir.
+"""Restore checkpoints into closed-loop policies and serving engines.
 
 The missing half of the reference's eval entry point
 (`/root/reference/language_table/eval/main_rt1.py:52-76` builds the network
 and loads a `.pth` by hand): given the training config and workdir, rebuild
 the model, restore the newest (or a chosen) checkpoint, and wrap it in
-`RT1EvalPolicy` ready for `evaluate_policy`.
+`RT1EvalPolicy` ready for `evaluate_policy` — or in a multi-session
+`rt1_tpu.serve.PolicyEngine` for the batched inference service.
 
 Extracted from `scripts/learn_proof.py` (VERDICT r4 weak #7) so framework
 users get checkpoint->policy as a library call, not script internals.
+`build_model_and_state` / `restore_variables` hold the dataset-free
+synthetic-shape init shared by `eval/main.py` and `python -m rt1_tpu.serve`.
 """
 
 from __future__ import annotations
 
 import os
+
+
+def build_model_and_state(config):
+    """Model + randomly initialized train state from synthetic example
+    shapes — no dataset on disk required (unlike `restore_eval_policy`).
+
+    Returns (model, state, family, lava_clip); `lava_clip` flags the LAVA
+    variant whose observation contract includes CLIP instruction tokens.
+    """
+    import jax
+    import numpy as np
+
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from rt1_tpu.train.train import build_family
+    from rt1_tpu.trainer import create_train_state, make_optimizer
+
+    model, init_fn, _ = build_family(config.model)
+    rng = jax.random.PRNGKey(0)
+    t = config.model.time_sequence_length
+    h, w = config.data.height, config.data.width
+    obs = {
+        "image": np.zeros((1, t, h, w, 3), np.float32),
+        "natural_language_embedding": np.zeros((1, t, 512), np.float32),
+    }
+    family = config.model.get("family", "rt1")
+    lava_clip = family == "lava" and config.model.lava.lang_encoder == "clip"
+    if lava_clip:
+        obs["instruction_tokenized_clip"] = np.zeros(
+            (1, t, config.model.lava.get("text_context", 77)), np.int32
+        )
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 1), (1, t)
+    )
+    state = create_train_state(
+        model, rng, (obs, actions), make_optimizer(), init_fn=init_fn
+    )
+    return model, state, family, lava_clip
+
+
+def _variables_from_state(state):
+    variables = {"params": state.params}
+    if state.batch_stats:  # efficientnet_b3 tokenizer carries BatchNorm stats
+        variables["batch_stats"] = state.batch_stats
+    return variables
+
+
+def restore_variables(config, workdir, step=None):
+    """Dataset-free build + checkpoint restore.
+
+    Returns (model, variables, restored_step, family, lava_clip). Raises
+    FileNotFoundError on an empty workdir — silently serving/evaluating
+    randomly initialized weights would be worse than failing.
+    """
+    from rt1_tpu.trainer.checkpoints import CheckpointConfig, CheckpointManager
+
+    model, state, family, lava_clip = build_model_and_state(config)
+    ckpt = CheckpointManager(
+        CheckpointConfig(
+            directory=os.path.join(os.path.abspath(workdir), "checkpoints")
+        )
+    )
+    state = ckpt.restore(state, step=step)
+    restored_step = step if step is not None else ckpt.latest_step()
+    return model, _variables_from_state(state), restored_step, family, lava_clip
+
+
+def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
+    """Feed a checkpoint (or random init when `workdir` is None) into a
+    multi-session serving engine. Returns (engine, checkpoint_step);
+    checkpoint_step is -1 for random init."""
+    from rt1_tpu.serve.engine import PolicyEngine
+
+    if workdir is None:
+        model, state, family, _ = build_model_and_state(config)
+        variables, restored_step = _variables_from_state(state), -1
+    else:
+        model, variables, restored_step, family, _ = restore_variables(
+            config, workdir, step=step
+        )
+    if family != "rt1":
+        raise ValueError(
+            f"the serving engine batches RT-1 rolling network state; "
+            f"family={family!r} is not servable (use the eval harness)"
+        )
+    return PolicyEngine(model, variables, **engine_kwargs), restored_step
 
 
 def restore_eval_policy(config, train_dir: str, step: int | None = None):
@@ -51,7 +139,4 @@ def restore_eval_policy(config, train_dir: str, step: int | None = None):
     )
     state = ckpt.restore(jax.device_get(state), step=step)
     print(f"restored checkpoint at step {int(state.step)}")
-    variables = {"params": state.params}
-    if state.batch_stats:  # efficientnet_b3 tokenizer carries BatchNorm stats
-        variables["batch_stats"] = state.batch_stats
-    return RT1EvalPolicy(model, variables)
+    return RT1EvalPolicy(model, _variables_from_state(state))

@@ -1,0 +1,34 @@
+"""Batched async inference service for the RT-1 policy.
+
+The train→eval→serve third leg (docs/serving.md): `PolicyEngine` holds many
+sessions' rolling network state as slots of one donated device batch and
+steps them in a single AOT-compiled call; `MicroBatcher` coalesces
+concurrent requests under a latency deadline with bounded-queue
+backpressure; `server.py` exposes the stdlib HTTP frontend
+(`python -m rt1_tpu.serve`); `metrics.py` tracks latency/occupancy/
+throughput in `trainer/metrics.py` writer conventions.
+"""
+
+from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
+from rt1_tpu.serve.engine import PolicyEngine, SessionError
+from rt1_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from rt1_tpu.serve.server import (
+    ServeApp,
+    install_signal_handlers,
+    make_server,
+    parse_observation,
+)
+
+__all__ = [
+    "BusyError",
+    "DrainingError",
+    "MicroBatcher",
+    "PolicyEngine",
+    "SessionError",
+    "LatencyHistogram",
+    "ServeMetrics",
+    "ServeApp",
+    "install_signal_handlers",
+    "make_server",
+    "parse_observation",
+]

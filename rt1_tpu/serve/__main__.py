@@ -1,0 +1,135 @@
+"""Serving entry point: `python -m rt1_tpu.serve`.
+
+Run (tiny smoke config, random weights, CPU):
+
+  JAX_PLATFORMS=cpu python -m rt1_tpu.serve \
+      --config rt1_tpu/train/configs/tiny.py --random_init --port 8321
+
+Run (trained checkpoint):
+
+  python -m rt1_tpu.serve --config rt1_tpu/train/configs/language_table.py \
+      --workdir /tmp/vt --port 8321 --embedder ngram
+
+Prints one JSON ready-line (`{"status": "serving", "port": ...}`) once the
+batched step is AOT-compiled and the socket is bound, then serves until
+SIGTERM/SIGINT, which drains accepted requests before exiting.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv):
+    del argv
+    from absl import flags
+
+    # Persistent XLA cache BEFORE any jax compile: the serving process's
+    # single batched-step compile is served from disk on restarts.
+    from rt1_tpu import compilation_cache
+
+    compilation_cache.enable_persistent_cache()
+
+    from rt1_tpu.eval.embedding import get_embedder
+    from rt1_tpu.eval.restore import build_serve_engine
+    from rt1_tpu.serve.server import (
+        ServeApp,
+        install_signal_handlers,
+        make_server,
+    )
+
+    FLAGS = flags.FLAGS
+    config = FLAGS.config
+    if not FLAGS.random_init and not FLAGS.allow_embedder_mismatch:
+        # Same guard as eval/main.py: serving a checkpoint with a different
+        # instruction embedder than it was trained on would hand the policy
+        # foreign-domain embeddings and score ~random with 200 OK.
+        from rt1_tpu.data.collect import check_embedder_compatibility
+
+        check_embedder_compatibility(
+            FLAGS.workdir,
+            FLAGS.embedder,
+            context="checkpoint data_manifest; pass "
+            "--allow_embedder_mismatch to override",
+            manifest_name="data_manifest.json",
+        )
+    engine, step = build_serve_engine(
+        config,
+        workdir=None if FLAGS.random_init else FLAGS.workdir,
+        max_sessions=FLAGS.max_sessions,
+        embedder=get_embedder(FLAGS.embedder),
+    )
+    app = ServeApp(
+        engine,
+        image_shape=(config.data.height, config.data.width, 3),
+        max_batch=FLAGS.max_batch or None,
+        max_delay_s=FLAGS.max_delay_ms / 1e3,
+        max_queue=FLAGS.max_queue,
+        request_timeout_s=FLAGS.request_timeout_s,
+    )
+    app.start(warmup=True)
+    httpd = make_server(app, host=FLAGS.host, port=FLAGS.port,
+                        quiet=not FLAGS.verbose)
+    install_signal_handlers(app, httpd)
+    print(
+        json.dumps(
+            {
+                "status": "serving",
+                "host": httpd.server_address[0],
+                "port": httpd.server_address[1],
+                "checkpoint_step": step,
+                "max_sessions": engine.max_sessions,
+                "compile_count": engine.compile_count,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        if not app.draining:
+            app.drain()
+    print(json.dumps({"status": "drained", **app.metrics_snapshot()}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    from absl import app as absl_app
+    from absl import flags
+    from ml_collections import config_flags
+
+    config_flags.DEFINE_config_file("config", None, "Model/data config.")
+    flags.DEFINE_string("workdir", "/tmp/rt1_tpu", "Checkpoint directory.")
+    flags.DEFINE_bool(
+        "random_init", False,
+        "Serve randomly initialized weights (smoke tests / load generation; "
+        "no checkpoint needed).")
+    flags.DEFINE_string("host", "127.0.0.1", "Bind address.")
+    flags.DEFINE_integer("port", 8321, "Bind port (0 = ephemeral).")
+    flags.DEFINE_integer(
+        "max_sessions", 8,
+        "Concurrent session slots = fixed device batch size.")
+    flags.DEFINE_integer(
+        "max_batch", 0,
+        "Micro-batch flush size (0 = max_sessions).")
+    flags.DEFINE_float(
+        "max_delay_ms", 10.0,
+        "Micro-batching deadline: longest a request waits for batchmates.")
+    flags.DEFINE_integer(
+        "max_queue", 64,
+        "Bounded admission queue; beyond this /act returns 503 busy.")
+    flags.DEFINE_float(
+        "request_timeout_s", 60.0, "Server-side per-request timeout.")
+    flags.DEFINE_string(
+        "embedder", "hash",
+        "Instruction embedder spec (hash | ngram | use | table.npz).")
+    flags.DEFINE_bool(
+        "allow_embedder_mismatch", False,
+        "Serve even if the checkpoint's data manifest records a different "
+        "instruction embedder.")
+    flags.DEFINE_bool("verbose", False, "Log per-request lines.")
+    flags.mark_flags_as_required(["config"])
+    sys.exit(absl_app.run(main))

@@ -1,0 +1,211 @@
+"""Async micro-batching queue for the serving frontend.
+
+The closed-loop eval policy is batch-size-1 by construction (one env, 10 Hz);
+a serving process instead sees many concurrent sessions whose `act` requests
+arrive independently. Running them one-by-one leaves the accelerator idle
+between dispatches, so the batcher holds each request briefly — up to
+`max_batch` requests or a `max_delay_s` deadline, whichever comes first — and
+hands the whole batch to `process_fn` in one call (the continuous-batching
+scheduler shape of Orca/vLLM-style servers, scaled down to a fixed-slot
+policy engine).
+
+Design points:
+
+* **Bounded queue + explicit backpressure.** `submit` raises `BusyError`
+  the moment the queue holds `max_queue` requests; the HTTP layer maps it
+  to 503 so load sheds at admission instead of growing unbounded latency.
+* **Per-key exclusion.** `batch_key` (the session id in production) keeps
+  two requests for the same key out of one batch: a session's rolling
+  network state must see its observations in order, one step at a time.
+  The second request stays queued for the next flush; requests for other
+  sessions may overtake it, but per-key FIFO order is preserved.
+* **Drain, not abort.** `drain()` rejects new submissions (`DrainingError`)
+  but flushes everything already admitted before returning — SIGTERM never
+  drops an accepted request.
+
+`process_fn` runs in a single-worker executor so the (blocking, device-
+bound) batched step never stalls the event loop; requests keep accumulating
+for the next batch while the current one computes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class BusyError(RuntimeError):
+    """Queue is at `max_queue`; the caller should shed load (HTTP 503)."""
+
+
+class DrainingError(RuntimeError):
+    """The batcher is shutting down and no longer admits requests."""
+
+
+class MicroBatcher:
+    """Collects concurrent requests into deadline- or size-triggered batches."""
+
+    def __init__(
+        self,
+        process_fn: Callable[[List[Any]], Sequence[Any]],
+        *,
+        max_batch: int = 8,
+        max_delay_s: float = 0.010,
+        max_queue: int = 64,
+        batch_key: Optional[Callable[[Any], Any]] = None,
+        metrics: Optional[Any] = None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._process_fn = process_fn
+        self._max_batch = max_batch
+        self._max_delay_s = max_delay_s
+        self._max_queue = max_queue
+        self._batch_key = batch_key
+        self._metrics = metrics
+        self._pending: collections.deque = collections.deque()
+        self._draining = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._event: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        """Bind to the running loop and start the flush worker."""
+        if self._task is not None:
+            raise RuntimeError("MicroBatcher already started")
+        self._loop = asyncio.get_running_loop()
+        self._event = asyncio.Event()
+        # One worker: the device executes batches serially anyway, and a
+        # single thread keeps engine state access naturally ordered.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rt1-serve-step"
+        )
+        self._task = self._loop.create_task(self._run())
+
+    async def drain(self) -> None:
+        """Stop admitting, flush every queued request, stop the worker."""
+        self._draining = True
+        if self._event is not None:
+            self._event.set()
+        if self._task is not None:
+            await self._task
+            self._task = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def qsize(self) -> int:
+        return len(self._pending)
+
+    # ------------------------------------------------------------ admission
+
+    async def submit(self, item: Any) -> Any:
+        """Queue one request; resolves with its element of `process_fn`'s
+        result list. Raises `BusyError`/`DrainingError` at admission."""
+        if self._draining:
+            raise DrainingError("batcher is draining; not accepting requests")
+        if self._task is None:
+            raise RuntimeError("MicroBatcher not started (call start())")
+        if len(self._pending) >= self._max_queue:
+            if self._metrics is not None:
+                self._metrics.observe_rejected()
+            raise BusyError(
+                f"queue full ({self._max_queue} pending requests)"
+            )
+        future = self._loop.create_future()
+        self._pending.append((item, future))
+        self._event.set()
+        try:
+            return await future
+        except asyncio.CancelledError:
+            # Abandoned caller (e.g. the HTTP bridge timed out and
+            # cancelled us): cancel the queued request so _take_batch
+            # drops it instead of stepping state for a dead client.
+            future.cancel()
+            raise
+
+    # ------------------------------------------------------------ worker
+
+    def _take_batch(self) -> List[Any]:
+        """Pop up to `max_batch` requests, skipping (not reordering within)
+        duplicate `batch_key`s — they wait for the next flush."""
+        taken = []
+        keys = set()
+        i = 0
+        while i < len(self._pending) and len(taken) < self._max_batch:
+            item, future = self._pending[i]
+            if future.done():  # cancelled by an abandoned submitter
+                del self._pending[i]
+                continue
+            key = self._batch_key(item) if self._batch_key else None
+            if key is not None and key in keys:
+                i += 1
+                continue
+            del self._pending[i]
+            if key is not None:
+                keys.add(key)
+            taken.append((item, future))
+        return taken
+
+    async def _wait_for_deadline(self) -> None:
+        deadline = self._loop.time() + self._max_delay_s
+        while len(self._pending) < self._max_batch and not self._draining:
+            remaining = deadline - self._loop.time()
+            if remaining <= 0:
+                return
+            self._event.clear()
+            if len(self._pending) >= self._max_batch or self._draining:
+                return  # recheck after clear: a submit may have raced
+            try:
+                await asyncio.wait_for(self._event.wait(), remaining)
+            except asyncio.TimeoutError:
+                return
+
+    async def _run(self) -> None:
+        while True:
+            if not self._pending:
+                if self._draining:
+                    return
+                self._event.clear()
+                if self._pending or self._draining:
+                    continue
+                await self._event.wait()
+                continue
+            if not self._draining and len(self._pending) < self._max_batch:
+                await self._wait_for_deadline()
+            batch = self._take_batch()
+            if not batch:
+                continue
+            if self._metrics is not None:
+                self._metrics.observe_batch(
+                    len(batch), queued=len(self._pending)
+                )
+            items = [item for item, _ in batch]
+            try:
+                results = await self._loop.run_in_executor(
+                    self._executor, self._process_fn, items
+                )
+                if len(results) != len(items):
+                    raise RuntimeError(
+                        f"process_fn returned {len(results)} results for "
+                        f"{len(items)} requests"
+                    )
+            except Exception as exc:  # noqa: BLE001 - forwarded per-request
+                for _, future in batch:
+                    if not future.done():
+                        future.set_exception(exc)
+                continue
+            for (_, future), result in zip(batch, results):
+                if not future.done():
+                    future.set_result(result)

@@ -1,0 +1,464 @@
+"""Multi-session RT-1 policy engine: one batched, AOT-compiled control step.
+
+`RT1Policy.infer_step` keeps a rolling per-stream window (context image
+tokens, action tokens, seq_idx) whose roll-vs-insert decision depends on
+that stream's `seq_idx` — a scalar in the model's state pytree, so a naive
+batched call would force every stream to the same phase. The engine instead
+`vmap`s a single-stream step over a fixed number of **slots**: every leaf of
+the engine state carries a leading slot axis (`seq_idx` becomes `(N,)`),
+each session owns one slot, and sessions at different points of their
+episode coexist in one device batch.
+
+Fixed shapes, one compile: the batch is always padded to `max_sessions`
+with an `active` mask; inactive slots compute garbage that is discarded and
+their state is `where`-gated back to its previous value. The step is
+lowered and compiled **ahead of time** (`jax.jit(...).lower(...).compile()`)
+so exactly one XLA compilation of the batched step ever happens — a later
+shape mismatch is a hard error, not a silent recompile. The state argument
+is donated: the rolling window updates in place on device, no per-step copy.
+
+Host-side the engine adds the serving conveniences the eval policy never
+needed: session→slot assignment with LRU reclaim, per-slot reset, action
+de-normalization/clipping, and an LRU instruction-embedding cache keyed by
+`ClipBPETokenizer` output so textual variants of one instruction ("Push the
+red moon" / "push  the red moon") hit one cache line and skip the text
+tower / embedder entirely.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+EPS = np.finfo(np.float32).eps
+EMBEDDING_DIM = 512
+
+
+class SessionError(RuntimeError):
+    """Invalid session usage (duplicate id in one batch, unknown release)."""
+
+
+class PolicyEngine:
+    """Holds N session slots of rolling network state in one device batch."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        *,
+        max_sessions: int = 8,
+        action_mean: float = 0.0,
+        action_std: float = 1.0,
+        action_minimum: float = -0.03,
+        action_maximum: float = 0.03,
+        embedder: Optional[Callable[[str], np.ndarray]] = None,
+        embed_cache_size: int = 256,
+        tokenizer=None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if max_sessions < 1:
+            raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
+        self._jax = jax
+        self._model = model
+        self._variables = variables
+        self.max_sessions = max_sessions
+        self.action_mean = action_mean
+        self.action_std = action_std
+        self.action_minimum = action_minimum
+        self.action_maximum = action_maximum
+        self._embedder = embedder
+        self._embed_cache_size = embed_cache_size
+        self._embed_cache: collections.OrderedDict = collections.OrderedDict()
+        self._tokenizer = tokenizer
+        self.embed_calls = 0  # embedder invocations (cache misses)
+
+        # Engine state: per-slot leaves stacked on a leading slot axis. The
+        # model's initial_state(batch_size=1) provides per-leaf shapes/dtypes;
+        # seq_idx is its only unbatched (scalar) leaf.
+        single = model.initial_state(batch_size=1)
+        self._state = jax.tree.map(
+            lambda x: jnp.zeros(
+                (max_sessions,) + (x.shape[1:] if x.ndim else ()), x.dtype
+            ),
+            single,
+        )
+
+        # Session bookkeeping. OrderedDict doubles as the LRU order:
+        # move_to_end on every act, popitem(last=False) to reclaim.
+        self._lock = threading.RLock()
+        self._embed_lock = threading.Lock()
+        self._sessions: collections.OrderedDict = collections.OrderedDict()
+        self._free: List[int] = list(range(max_sessions))
+        self.evictions = 0  # LRU slot reclaims (oversubscription signal)
+
+        # AOT compilation happens lazily at the first act (or explicit
+        # warmup()) because only then are H, W and the embedding dim known.
+        self._compiled = None
+        self._compiled_obs_shapes: Optional[Dict[str, Tuple]] = None
+        self.compile_count = 0
+
+    # ------------------------------------------------------------ embedding
+
+    def _embed_instruction(self, text: str) -> np.ndarray:
+        """Instruction text -> embedding, LRU-cached on the BPE token ids.
+
+        Keying on `ClipBPETokenizer` output (not the raw string) folds
+        case/whitespace/punctuation variants that tokenize identically into
+        one entry, so a fleet of clients phrasing the same command slightly
+        differently still skips the embedder after the first hit.
+        """
+        if self._embedder is None:
+            raise SessionError(
+                "request carried an 'instruction' string but the engine has "
+                "no embedder; pass embedder= (rt1_tpu.eval.embedding."
+                "get_embedder) or send 'natural_language_embedding' directly"
+            )
+        if self._tokenizer is None:
+            from rt1_tpu.text.clip_bpe import default_tokenizer
+
+            self._tokenizer = default_tokenizer()
+        try:
+            key = self._tokenizer.tokenize_text(text).tobytes()
+        except ValueError:  # longer than the 77-token CLIP context
+            key = b"raw\x00" + text.encode("utf-8")
+        with self._embed_lock:
+            cached = self._embed_cache.get(key)
+            if cached is not None:
+                self._embed_cache.move_to_end(key)
+                return cached
+        vec = np.asarray(self._embedder(text), np.float32)
+        with self._embed_lock:
+            self.embed_calls += 1
+            self._embed_cache[key] = vec
+            while len(self._embed_cache) > self._embed_cache_size:
+                self._embed_cache.popitem(last=False)
+        return vec
+
+    # ------------------------------------------------------------ compile
+
+    def _build_step(self, obs_shapes: Dict[str, Tuple[int, ...]]):
+        """Lower + compile the batched step for fixed per-item obs shapes."""
+        import jax
+        import jax.numpy as jnp
+
+        model, variables = self._model, self._variables
+
+        def single_step(obs, state):
+            # One slot == one batch-1 infer_step; vmap gives each lane its
+            # own scalar seq_idx (per-slot roll phase), which the batched
+            # state pytree cannot express directly.
+            obs_b = {k: v[None] for k, v in obs.items()}
+            state_b = {
+                "context_image_tokens": state["context_image_tokens"][None],
+                "action_tokens": state["action_tokens"][None],
+                "seq_idx": state["seq_idx"],
+            }
+            out, new_state = model.apply(
+                variables, obs_b, state_b, method=model.infer_step
+            )
+            out = jax.tree.map(lambda x: x[0], out)
+            new_state = {
+                "context_image_tokens": new_state["context_image_tokens"][0],
+                "action_tokens": new_state["action_tokens"][0],
+                "seq_idx": new_state["seq_idx"],
+            }
+            return out, new_state
+
+        def batched_step(obs, active, state):
+            out, stepped = jax.vmap(single_step)(obs, state)
+
+            def gate(new, old):
+                mask = active.reshape(
+                    active.shape + (1,) * (new.ndim - 1)
+                )
+                return jnp.where(mask, new, old)
+
+            # Inactive slots ran on padding; their rolling state must not
+            # advance. Gating inside the compiled step keeps the whole
+            # update a single donated in-place device program.
+            return out, jax.tree.map(gate, stepped, state)
+
+        n = self.max_sessions
+        obs_spec = {
+            k: jax.ShapeDtypeStruct((n,) + tuple(shape), np.float32)
+            for k, shape in obs_shapes.items()
+        }
+        active_spec = jax.ShapeDtypeStruct((n,), np.bool_)
+        state_spec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state
+        )
+        lowered = jax.jit(batched_step, donate_argnums=(2,)).lower(
+            obs_spec, active_spec, state_spec
+        )
+        self._compiled = lowered.compile()
+        self._compiled_obs_shapes = dict(obs_shapes)
+        self.compile_count += 1
+
+    def warmup(
+        self,
+        image_shape: Sequence[int],
+        embed_dim: int = EMBEDDING_DIM,
+    ) -> None:
+        """AOT-compile the batched step before traffic arrives.
+
+        `image_shape` is the per-item (H, W, 3); pair with
+        `compilation_cache.enable_persistent_cache()` at process startup so
+        even the single compile is served from disk on restarts.
+        """
+        with self._lock:
+            self._ensure_compiled(
+                {
+                    "image": tuple(image_shape),
+                    "natural_language_embedding": (embed_dim,),
+                }
+            )
+
+    def _ensure_compiled(self, obs_shapes: Dict[str, Tuple[int, ...]]):
+        if self._compiled is None:
+            self._build_step(obs_shapes)
+        elif self._compiled_obs_shapes != obs_shapes:
+            raise ValueError(
+                f"observation shapes {obs_shapes} do not match the compiled "
+                f"step {self._compiled_obs_shapes}; the engine serves one "
+                "fixed shape per process (pad/resize client-side)"
+            )
+
+    # ------------------------------------------------------------ sessions
+
+    def _slot_for(
+        self, session_id: str, create: bool = True, protected: frozenset = frozenset()
+    ) -> int:
+        slot = self._sessions.get(session_id)
+        if slot is not None:
+            self._sessions.move_to_end(session_id)
+            return slot
+        if not create:
+            raise SessionError(f"unknown session {session_id!r}")
+        if self._free:
+            slot = self._free.pop()
+        else:
+            # Reclaim the least-recently-used session's slot. The evicted
+            # session is forgotten; if it comes back it starts a fresh
+            # window (clients idle past the slot budget should /reset).
+            # `protected` holds the current batch's session ids — a session
+            # being stepped right now must never be the eviction victim.
+            victim = next(iter(self._sessions))
+            if victim in protected:
+                raise SessionError(
+                    f"no reclaimable slot for session {session_id!r}: all "
+                    f"{self.max_sessions} slots belong to this batch"
+                )
+            slot = self._sessions.pop(victim)
+            self.evictions += 1
+        self._sessions[session_id] = slot
+        self._zero_slot(slot)
+        return slot
+
+    def _zero_slot(self, slot: int) -> None:
+        self._state = self._jax.tree.map(
+            lambda x: x.at[slot].set(0), self._state
+        )
+
+    def reset(self, session_id: str) -> int:
+        """Zero a session's rolling window (allocating a slot if new)."""
+        with self._lock:
+            slot = self._slot_for(session_id)
+            self._zero_slot(slot)
+            return slot
+
+    def release(self, session_id: str) -> None:
+        """Forget a session and return its slot to the free list."""
+        with self._lock:
+            slot = self._sessions.pop(session_id, None)
+            if slot is None:
+                raise SessionError(f"unknown session {session_id!r}")
+            self._free.append(slot)
+
+    @property
+    def active_sessions(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def session_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._sessions)
+
+    def session_state(self, session_id: str) -> Dict[str, np.ndarray]:
+        """One session's unbatched state pytree, pulled to host (debug/tests).
+        Pure read: does NOT refresh the session's LRU recency — inspecting
+        a session must not change which one gets evicted next."""
+        with self._lock:
+            slot = self._sessions.get(session_id)
+            if slot is None:
+                raise SessionError(f"unknown session {session_id!r}")
+            return self._jax.tree.map(
+                lambda x: np.asarray(x[slot]), self._state
+            )
+
+    # ------------------------------------------------------------ stepping
+
+    def _resolve_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        image = np.asarray(obs["image"], np.float32)
+        if "natural_language_embedding" in obs:
+            embedding = np.asarray(
+                obs["natural_language_embedding"], np.float32
+            )
+        else:
+            embedding = self._embed_instruction(obs["instruction"])
+        return {"image": image, "natural_language_embedding": embedding}
+
+    def act_batch(
+        self, items: Sequence[Tuple[str, Dict[str, Any]]]
+    ) -> List[Dict[str, Any]]:
+        """Run one batched control step for `items` = [(session_id, obs)].
+
+        Each obs carries `image` (H, W, 3) float32 in [0, 1] plus either
+        `natural_language_embedding` (D,) or `instruction` (str). Returns
+        one dict per item: the de-normalized, clipped `action` and the raw
+        `action_tokens` — or `{"error": ...}` for an item whose observation
+        failed to resolve/validate (a bad request must not poison its
+        batchmates; its session state does not advance). Session ids must
+        be unique within one batch (the batcher's `batch_key` guarantees it
+        in the serving path).
+        """
+        if not items:
+            return []
+        if len(items) > self.max_sessions:
+            raise SessionError(
+                f"batch of {len(items)} exceeds max_sessions="
+                f"{self.max_sessions}"
+            )
+        ids = [sid for sid, _ in items]
+        if len(set(ids)) != len(ids):
+            raise SessionError(
+                f"duplicate session ids in one batch: {ids} — a "
+                "session's rolling state must step one obs at a time"
+            )
+
+        # Resolve (and possibly embed) OUTSIDE the lock: an embedder cache
+        # miss may be an expensive text-tower forward, and gauge readers
+        # (/healthz, /metrics) must not stall behind it. Per-item failures
+        # become per-item error results, not a poisoned batch.
+        resolved: List[Optional[Dict[str, np.ndarray]]] = []
+        errors: List[Optional[Exception]] = []
+        for sid, obs in items:
+            try:
+                resolved.append(self._resolve_obs(obs))
+                errors.append(None)
+            except Exception as exc:  # noqa: BLE001 - isolated per item
+                resolved.append(None)
+                errors.append(exc)
+
+        good = [
+            (i, sid, obs)
+            for i, ((sid, _), obs) in enumerate(zip(items, resolved))
+            if obs is not None
+        ]
+        slots_by_sid: Dict[str, int] = {}
+        fresh: set = set()
+        if good:
+            with self._lock:
+                # First use compiles (shapes come from the first item);
+                # afterwards mismatches are handled per item below.
+                if self._compiled is None:
+                    self._build_step(
+                        {k: v.shape for k, v in good[0][2].items()}
+                    )
+
+                # Per-item shape check BEFORE any slot is assigned: a
+                # mismatched item becomes its own error result instead of
+                # poisoning the batch (and allocates no slot).
+                kept = []
+                for i, sid, obs in good:
+                    bad_key = next(
+                        (
+                            k
+                            for k, v in obs.items()
+                            if v.shape != self._compiled_obs_shapes[k]
+                        ),
+                        None,
+                    )
+                    if bad_key is not None:
+                        errors[i] = ValueError(
+                            f"session {sid!r} obs {bad_key!r} shape "
+                            f"{obs[bad_key].shape} != compiled "
+                            f"{self._compiled_obs_shapes[bad_key]}"
+                        )
+                    else:
+                        kept.append((sid, obs))
+
+                # Two-pass slot assignment: touch every EXISTING batch
+                # session first (marking it most-recently-used) so a new
+                # session's LRU reclaim can never evict a batchmate whose
+                # step is in flight. `fresh` marks sessions starting a new
+                # (zeroed) window this step — surfaced in the result so a
+                # client whose session was LRU-evicted can detect the
+                # silent context reset instead of acting on it unaware.
+                fresh.update(
+                    sid for sid, _ in kept if sid not in self._sessions
+                )
+                batch_ids = frozenset(sid for sid, _ in kept)
+                for sid, _ in kept:
+                    if sid in self._sessions:
+                        slots_by_sid[sid] = self._slot_for(sid)
+                for sid, _ in kept:
+                    if sid not in slots_by_sid:
+                        slots_by_sid[sid] = self._slot_for(
+                            sid, protected=batch_ids
+                        )
+
+                if kept:
+                    n = self.max_sessions
+                    batch_obs = {
+                        k: np.zeros((n,) + tuple(shape), np.float32)
+                        for k, shape in self._compiled_obs_shapes.items()
+                    }
+                    active = np.zeros((n,), np.bool_)
+                    for sid, obs in kept:
+                        slot = slots_by_sid[sid]
+                        for k, v in obs.items():
+                            batch_obs[k][slot] = v
+                        active[slot] = True
+
+                    out, self._state = self._compiled(
+                        batch_obs, active, self._state
+                    )
+
+                    actions = np.asarray(out["action"])
+                    tokens = np.asarray(out["action_tokens"])
+                    terminate = (
+                        np.asarray(out["terminate_episode"])
+                        if "terminate_episode" in out
+                        else None
+                    )
+
+        results: List[Dict[str, Any]] = []
+        for (sid, _), error in zip(items, errors):
+            if error is not None:
+                results.append({"error": error})
+                continue
+            slot = slots_by_sid[sid]
+            action = actions[slot] * max(self.action_std, EPS) + self.action_mean
+            action = np.clip(action, self.action_minimum, self.action_maximum)
+            result = {
+                "action": action.astype(np.float32),
+                "action_tokens": tokens[slot],
+                "session_started": sid in fresh,
+            }
+            if terminate is not None:
+                result["terminate_episode"] = int(terminate[slot])
+            results.append(result)
+        return results
+
+    def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
+        """Single-session convenience wrapper over `act_batch`; re-raises
+        the item's error (act_batch's markers exist for batchmates)."""
+        result = self.act_batch([(session_id, obs)])[0]
+        if "error" in result:
+            raise result["error"]
+        return result

@@ -1,0 +1,149 @@
+"""Serving-side metrics: latency histograms, batch occupancy, throughput.
+
+Follows the `rt1_tpu/trainer/metrics.py` conventions — plain-Python
+accumulators on the host, scalars published through the same clu
+`metric_writers` interface (`create_writer` / `write_scalars`) when a
+metrics workdir is configured, and a JSON `snapshot()` for the HTTP
+`/metrics` endpoint and `scripts/serve_loadgen.py`.
+
+Counters are lock-guarded: requests land from many HTTP handler threads
+while batches complete on the batcher's executor thread.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# Geometric-ish bucket upper bounds in seconds, 0.1 ms .. 30 s. Wide enough
+# for a tiny-CPU smoke model (sub-ms) and a cold remote-TPU dispatch alike.
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket histogram with conservative (upper-bound) quantiles."""
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +1: overflow bucket
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, seconds)] += 1
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket containing the q-quantile (0 if empty).
+        The overflow bucket reports the observed max."""
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        cumulative = 0
+        for i, upper in enumerate(self.buckets):
+            cumulative += self.counts[i]
+            if cumulative >= rank:
+                return upper
+        return self.max
+
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class ServeMetrics:
+    """Aggregates the serving process's request/batch/session counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        self.requests_total = 0
+        self.errors_total = 0
+        self.rejected_total = 0
+        self.resets_total = 0
+        self.batches_total = 0
+        self.occupancy_sum = 0
+        self.occupancy_max = 0
+        self.queue_depth = 0
+        self.latency = LatencyHistogram()      # full request wall time
+        self.step_latency = LatencyHistogram()  # batched device step only
+
+    # ------------------------------------------------------------ recording
+
+    def observe_request(self, seconds: float, ok: bool = True) -> None:
+        with self._lock:
+            self.requests_total += 1
+            if not ok:
+                self.errors_total += 1
+            self.latency.observe(seconds)
+
+    def observe_rejected(self) -> None:
+        with self._lock:
+            self.rejected_total += 1
+
+    def observe_reset(self) -> None:
+        with self._lock:
+            self.resets_total += 1
+
+    def observe_batch(self, size: int, queued: int = 0) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.occupancy_sum += size
+            self.occupancy_max = max(self.occupancy_max, size)
+            self.queue_depth = queued
+
+    def observe_step(self, seconds: float) -> None:
+        with self._lock:
+            self.step_latency.observe(seconds)
+
+    # ------------------------------------------------------------ reporting
+
+    def snapshot(self, **gauges: Any) -> Dict[str, Any]:
+        """One flat JSON-serializable dict; extra `gauges` (active_sessions,
+        compile_count, ...) are merged in by the caller that owns them."""
+        with self._lock:
+            uptime = time.monotonic() - self._started
+            out = {
+                "uptime_s": uptime,
+                "requests_total": self.requests_total,
+                "errors_total": self.errors_total,
+                "rejected_total": self.rejected_total,
+                "resets_total": self.resets_total,
+                "requests_per_sec": (
+                    self.requests_total / uptime if uptime > 0 else 0.0
+                ),
+                "latency_p50_ms": self.latency.quantile(0.5) * 1e3,
+                "latency_p99_ms": self.latency.quantile(0.99) * 1e3,
+                "latency_mean_ms": self.latency.mean() * 1e3,
+                "latency_max_ms": self.latency.max * 1e3,
+                "step_p50_ms": self.step_latency.quantile(0.5) * 1e3,
+                "step_p99_ms": self.step_latency.quantile(0.99) * 1e3,
+                "batches_total": self.batches_total,
+                "mean_batch_occupancy": (
+                    self.occupancy_sum / self.batches_total
+                    if self.batches_total
+                    else 0.0
+                ),
+                "max_batch_occupancy": self.occupancy_max,
+                "queue_depth": self.queue_depth,
+            }
+        out.update(gauges)
+        return out
+
+    def write_to(self, writer, step: int, **gauges: Any) -> None:
+        """Publish the snapshot through a clu metric writer (the
+        `trainer/metrics.py:create_writer` object), `serve/`-prefixed."""
+        scalars = {
+            f"serve/{k}": float(v)
+            for k, v in self.snapshot(**gauges).items()
+            if isinstance(v, (int, float))
+        }
+        writer.write_scalars(step, scalars)

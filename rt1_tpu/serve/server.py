@@ -1,0 +1,359 @@
+"""Stdlib-only HTTP frontend for the batched policy engine.
+
+Threading model: `ThreadingHTTPServer` gives every connection a handler
+thread (stdlib does the HTTP parsing); one background thread runs an
+asyncio loop that owns the `MicroBatcher`; the batcher's single-worker
+executor calls `PolicyEngine.act_batch`. Handler threads bridge into the
+loop with `run_coroutine_threadsafe` and block on the future — the batching
+concurrency lives in the loop, not in the handler count.
+
+Endpoints (all JSON):
+
+* `POST /act`    {"session_id", "image" | "image_b64", "instruction" |
+                  "embedding"} -> {"action", "action_tokens", ...}
+* `POST /reset`  {"session_id"} -> {"ok": true, "slot": i}
+* `POST /release` {"session_id"} -> {"ok": true}
+* `GET /healthz` liveness + model/input contract (clients read the
+                  expected image shape from here)
+* `GET /metrics` `ServeMetrics.snapshot()` + engine gauges
+
+Backpressure maps to HTTP: queue full -> 503 `busy`, draining -> 503
+`draining`. `install_signal_handlers` wires SIGTERM/SIGINT to a graceful
+drain: stop admitting, flush every accepted request, then stop serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import binascii
+import concurrent.futures
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
+from rt1_tpu.serve.engine import PolicyEngine, SessionError
+from rt1_tpu.serve.metrics import ServeMetrics
+
+
+class RequestError(ValueError):
+    """Malformed client payload -> HTTP 400."""
+
+
+def parse_observation(
+    payload: Dict[str, Any],
+    image_shape: Sequence[int],
+    embed_dim: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Decode one /act payload into an engine observation.
+
+    Images arrive either as a nested float list (already [0, 1]) or as
+    `image_b64` — base64 of raw uint8 H*W*3 bytes, the compact path the
+    load generator uses (a 32x56 frame is ~7 KB vs ~60 KB as JSON floats).
+    """
+    if "image_b64" in payload:
+        try:
+            raw = base64.b64decode(payload["image_b64"], validate=True)
+        except (binascii.Error, ValueError) as exc:
+            raise RequestError(f"image_b64 is not valid base64: {exc}") from exc
+        flat = np.frombuffer(raw, np.uint8)
+        expected = int(np.prod(image_shape))
+        if flat.size != expected:
+            raise RequestError(
+                f"image_b64 decodes to {flat.size} bytes, expected "
+                f"{expected} for shape {tuple(image_shape)}"
+            )
+        image = flat.reshape(image_shape).astype(np.float32) / 255.0
+    elif "image" in payload:
+        image = np.asarray(payload["image"], np.float32)
+        if image.shape != tuple(image_shape):
+            raise RequestError(
+                f"image shape {image.shape} != server shape "
+                f"{tuple(image_shape)}"
+            )
+    else:
+        raise RequestError("payload needs 'image' or 'image_b64'")
+    obs: Dict[str, Any] = {"image": image}
+    if "embedding" in payload:
+        embedding = np.asarray(payload["embedding"], np.float32)
+        if embed_dim is not None and embedding.shape != (embed_dim,):
+            raise RequestError(
+                f"embedding shape {embedding.shape} != ({embed_dim},)"
+            )
+        obs["natural_language_embedding"] = embedding
+    elif "instruction" in payload:
+        if not isinstance(payload["instruction"], str):
+            raise RequestError("'instruction' must be a string")
+        obs["instruction"] = payload["instruction"]
+    else:
+        raise RequestError("payload needs 'instruction' or 'embedding'")
+    return obs
+
+
+class ServeApp:
+    """Engine + batcher + metrics behind a thread-safe facade."""
+
+    def __init__(
+        self,
+        engine: PolicyEngine,
+        *,
+        image_shape: Sequence[int],
+        embed_dim: int = 512,
+        max_batch: Optional[int] = None,
+        max_delay_s: float = 0.010,
+        max_queue: int = 64,
+        request_timeout_s: float = 60.0,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        self.engine = engine
+        self.image_shape = tuple(image_shape)
+        self.embed_dim = embed_dim
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.request_timeout_s = request_timeout_s
+        self.draining = False
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, name="rt1-serve-loop", daemon=True
+        )
+        self.batcher = MicroBatcher(
+            self._process,
+            # A flush larger than the slot count would make act_batch
+            # reject the whole batch — clamp, don't trust the flag.
+            max_batch=min(max_batch or engine.max_sessions,
+                          engine.max_sessions),
+            max_delay_s=max_delay_s,
+            max_queue=max_queue,
+            batch_key=lambda item: item[0],  # one in-flight step per session
+            metrics=self.metrics,
+        )
+
+    def _process(self, items):
+        t0 = time.perf_counter()
+        results = self.engine.act_batch(items)
+        self.metrics.observe_step(time.perf_counter() - t0)
+        return results
+
+    def start(self, warmup: bool = True) -> None:
+        """Start the batcher loop; AOT-compile the batched step up front so
+        the first request pays network latency, not XLA latency."""
+        self._loop_thread.start()
+        asyncio.run_coroutine_threadsafe(
+            self.batcher.start(), self._loop
+        ).result(timeout=10)
+        if warmup:
+            self.engine.warmup(self.image_shape, self.embed_dim)
+
+    def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
+        """Blocking bridge used by HTTP handler threads."""
+        future = asyncio.run_coroutine_threadsafe(
+            self.batcher.submit((session_id, obs)), self._loop
+        )
+        try:
+            result = future.result(timeout=self.request_timeout_s)
+        except concurrent.futures.TimeoutError:
+            # Nobody is waiting for this request anymore — cancel it so a
+            # still-queued entry is dropped instead of stepping the
+            # session's rolling state for a dead client.
+            future.cancel()
+            raise
+        if "error" in result:
+            # The engine isolates a bad item as a per-item marker so its
+            # batchmates still step; surface it to THIS request only.
+            raise result["error"]
+        return result
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful shutdown: reject new work, flush everything admitted."""
+        self.draining = True
+        if self._loop_thread.is_alive():
+            asyncio.run_coroutine_threadsafe(
+                self.batcher.drain(), self._loop
+            ).result(timeout=timeout)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(timeout=timeout)
+
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "status": "draining" if self.draining else "ok",
+            "image_shape": list(self.image_shape),
+            "embed_dim": self.embed_dim,
+            "max_sessions": self.engine.max_sessions,
+            "active_sessions": self.engine.active_sessions,
+            "compile_count": self.engine.compile_count,
+        }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.metrics.snapshot(
+            active_sessions=self.engine.active_sessions,
+            compile_count=self.engine.compile_count,
+            embed_cache_misses=self.engine.embed_calls,
+            # Nonzero while serving steady traffic = more live sessions
+            # than slots; their context windows are thrashing to zero.
+            session_evictions=self.engine.evictions,
+        )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Accurate Content-Length is set on every response, so HTTP/1.1
+    # keep-alive is safe and saves the load generator a handshake per step.
+    protocol_version = "HTTP/1.1"
+    app: ServeApp = None  # bound by make_server
+    quiet: bool = True
+
+    def log_message(self, fmt, *args):  # noqa: D102 - stdlib hook
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0:
+            raise RequestError("missing request body")
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as exc:
+            raise RequestError(f"invalid JSON body: {exc}") from exc
+        if not isinstance(payload, dict):
+            raise RequestError("request body must be a JSON object")
+        return payload
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        if self.path == "/healthz":
+            self._reply(200, self.app.healthz())
+        elif self.path == "/metrics":
+            self._reply(200, self.app.metrics_snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib casing
+        try:
+            payload = self._read_json()
+        except RequestError as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        if self.path == "/act":
+            self._act(payload)
+        elif self.path == "/reset":
+            self._session_op(payload, self.app.engine.reset, "slot",
+                             count_reset=True)
+        elif self.path == "/release":
+            self._session_op(payload, self.app.engine.release, None)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def _session_id(self, payload) -> str:
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            raise RequestError("'session_id' must be a non-empty string")
+        return session_id
+
+    def _session_op(self, payload, op, result_key, count_reset=False):
+        try:
+            value = op(self._session_id(payload))
+        except RequestError as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        except SessionError as exc:
+            self._reply(404, {"error": str(exc)})
+            return
+        out = {"ok": True}
+        if result_key is not None:
+            out[result_key] = value
+        if count_reset:
+            self.app.metrics.observe_reset()
+        self._reply(200, out)
+
+    def _act(self, payload):
+        if self.app.draining:
+            self._reply(503, {"error": "draining"})
+            return
+        t0 = time.perf_counter()
+        try:
+            session_id = self._session_id(payload)
+            obs = parse_observation(
+                payload, self.app.image_shape, self.app.embed_dim
+            )
+            result = self.app.act(session_id, obs)
+        except RequestError as exc:
+            self.app.metrics.observe_request(
+                time.perf_counter() - t0, ok=False
+            )
+            self._reply(400, {"error": str(exc)})
+            return
+        except BusyError:
+            self._reply(503, {"error": "busy", "retry": True})
+            return
+        except DrainingError:
+            self._reply(503, {"error": "draining"})
+            return
+        except concurrent.futures.TimeoutError:
+            self.app.metrics.observe_request(
+                time.perf_counter() - t0, ok=False
+            )
+            self._reply(504, {"error": "request timed out in the server"})
+            return
+        except (SessionError, ValueError, KeyError) as exc:
+            # KeyError: a TableInstructionEmbedder miss. The engine turned
+            # per-item failures into markers; app.act re-raised this one —
+            # batchmates were unaffected.
+            self.app.metrics.observe_request(
+                time.perf_counter() - t0, ok=False
+            )
+            self._reply(400, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - last-resort HTTP 500
+            self.app.metrics.observe_request(
+                time.perf_counter() - t0, ok=False
+            )
+            self._reply(500, {"error": f"internal error: {exc}"})
+            return
+        self.app.metrics.observe_request(time.perf_counter() - t0)
+        out = {
+            "action": [float(x) for x in result["action"]],
+            "action_tokens": [int(x) for x in result["action_tokens"]],
+            # True when this step started a fresh (zeroed) window — a
+            # client that did not /reset just lost its slot to LRU reclaim.
+            "session_started": result.get("session_started", False),
+        }
+        if "terminate_episode" in result:
+            out["terminate_episode"] = result["terminate_episode"]
+        self._reply(200, out)
+
+
+def make_server(
+    app: ServeApp, host: str = "127.0.0.1", port: int = 0, quiet: bool = True
+) -> ThreadingHTTPServer:
+    """Bind a ThreadingHTTPServer to `app` (port 0 = ephemeral)."""
+    handler = type("BoundHandler", (_Handler,), {"app": app, "quiet": quiet})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def install_signal_handlers(
+    app: ServeApp, httpd: ThreadingHTTPServer
+) -> None:
+    """SIGTERM/SIGINT -> drain accepted requests, then stop the server."""
+
+    def _shutdown(signum, frame):  # noqa: ARG001 - signal signature
+        def _run():
+            app.drain()
+            httpd.shutdown()
+
+        threading.Thread(target=_run, name="rt1-serve-drain").start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)

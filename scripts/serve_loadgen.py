@@ -1,0 +1,240 @@
+"""Load generator for the `rt1_tpu.serve` inference service.
+
+Drives N concurrent synthetic sessions against a running server and emits
+one BENCH-style JSON line (the `bench.py` headline convention: metric /
+value / unit plus supporting fields) so serving performance can be tracked
+across PRs alongside `BENCH_*.json`:
+
+  # terminal 1
+  JAX_PLATFORMS=cpu python -m rt1_tpu.serve \
+      --config rt1_tpu/train/configs/tiny.py --random_init --port 8321
+  # terminal 2
+  python scripts/serve_loadgen.py --url http://127.0.0.1:8321 \
+      --sessions 8 --steps 32
+
+Each session thread: /reset, then a closed loop of /act requests carrying a
+random uint8 frame (base64-packed) and an instruction drawn from a small
+pool (so the server's embedding cache sees realistic reuse). 503 busy
+responses are retried with a short backoff and counted — backpressure is a
+measured quantity here, not an error. The image shape is read from the
+server's /healthz contract unless given explicitly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+INSTRUCTION_POOL = (
+    "push the red moon to the blue cube",
+    "move the blue cube to the green star",
+    "slide the yellow pentagon towards the red moon",
+    "separate the red moon from the blue cube",
+)
+
+
+def _post(url: str, payload: dict, timeout: float) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read())
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            body = {"error": str(exc)}
+        return exc.code, body
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        # Connection refused/reset, socket timeout, bad body: report as a
+        # transport failure (status 0) instead of killing the worker
+        # thread — a dead worker would break the start barrier for every
+        # other session.
+        return 0, {"error": str(exc)}
+
+
+def _get(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _session_worker(
+    url: str,
+    session_id: str,
+    steps: int,
+    image_shape: tuple,
+    instruction: str,
+    timeout: float,
+    barrier: threading.Barrier,
+    out: dict,
+    rng: np.random.Generator,
+):
+    latencies = []
+    busy = 0
+    errors = 0
+    # Record a result no matter how this thread exits, and never skip the
+    # barrier: a missing wait would deadlock every other session.
+    out[session_id] = {"latencies": latencies, "busy": 0, "errors": 0}
+    try:
+        status, _ = _post(url + "/reset", {"session_id": session_id}, timeout)
+        _barrier_wait(barrier, timeout)  # start all act loops together
+        if status != 200:
+            errors = steps  # reset failed; count the whole session as lost
+            return
+        for _ in range(steps):
+            frame = rng.integers(0, 256, size=image_shape, dtype=np.uint8)
+            payload = {
+                "session_id": session_id,
+                "image_b64": base64.b64encode(frame.tobytes()).decode("ascii"),
+                "instruction": instruction,
+            }
+            while True:
+                t0 = time.perf_counter()
+                status, body = _post(url + "/act", payload, timeout)
+                if status == 503 and body.get("retry"):
+                    busy += 1
+                    time.sleep(0.005)
+                    continue
+                break
+            if status == 200 and "action" in body:
+                latencies.append(time.perf_counter() - t0)
+            else:
+                errors += 1
+    finally:
+        out[session_id]["busy"] = busy
+        out[session_id]["errors"] = errors
+
+
+def _barrier_wait(barrier: threading.Barrier, timeout: float) -> None:
+    try:
+        barrier.wait(timeout=timeout)
+    except threading.BrokenBarrierError:
+        pass  # a sibling died/timed out; run unsynchronized rather than hang
+
+
+def run_loadgen(
+    url: str,
+    sessions: int = 8,
+    steps: int = 32,
+    image_shape=None,
+    timeout: float = 30.0,
+    seed: int = 0,
+) -> dict:
+    """Run the synthetic load and return the BENCH-style result dict."""
+    url = url.rstrip("/")
+    health = _get(url + "/healthz", timeout)
+    if image_shape is None:
+        image_shape = tuple(health["image_shape"])
+    barrier = threading.Barrier(sessions)
+    out: dict = {}
+    threads = []
+    t_start = time.perf_counter()
+    for i in range(sessions):
+        rng = np.random.default_rng(seed + i)
+        thread = threading.Thread(
+            target=_session_worker,
+            args=(
+                url,
+                f"loadgen-{i}",
+                steps,
+                image_shape,
+                INSTRUCTION_POOL[i % len(INSTRUCTION_POOL)],
+                timeout,
+                barrier,
+                out,
+                rng,
+            ),
+            name=f"loadgen-{i}",
+        )
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join()
+    wall = time.perf_counter() - t_start
+
+    latencies = sorted(
+        lat for result in out.values() for lat in result["latencies"]
+    )
+    busy = sum(result["busy"] for result in out.values())
+    errors = sum(result["errors"] for result in out.values())
+    server_metrics = _get(url + "/metrics", timeout)
+
+    def pct(q: float) -> float:
+        if not latencies:
+            return 0.0
+        return latencies[min(int(q * len(latencies)), len(latencies) - 1)]
+
+    return {
+        "metric": "serve_requests_per_sec",
+        "value": round(len(latencies) / wall, 3) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "sessions": sessions,
+        "steps_per_session": steps,
+        "requests_ok": len(latencies),
+        "requests_busy_retried": busy,
+        "requests_failed": errors,
+        "wall_s": round(wall, 4),
+        "latency_p50_ms": round(pct(0.50) * 1e3, 3),
+        "latency_p99_ms": round(pct(0.99) * 1e3, 3),
+        "mean_batch_occupancy": round(
+            server_metrics.get("mean_batch_occupancy", 0.0), 3
+        ),
+        "max_batch_occupancy": server_metrics.get("max_batch_occupancy", 0),
+        "server_compile_count": server_metrics.get("compile_count"),
+        "image_shape": list(image_shape),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--url", default="http://127.0.0.1:8321")
+    parser.add_argument("--sessions", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=32)
+    parser.add_argument(
+        "--height", type=int, default=0,
+        help="Frame height (0 = read from /healthz).")
+    parser.add_argument(
+        "--width", type=int, default=0,
+        help="Frame width (0 = read from /healthz).")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--output", default="",
+        help="Also write the JSON to this path (stdout either way).")
+    args = parser.parse_args()
+
+    image_shape = None
+    if args.height and args.width:
+        image_shape = (args.height, args.width, 3)
+    result = run_loadgen(
+        args.url,
+        sessions=args.sessions,
+        steps=args.steps,
+        image_shape=image_shape,
+        timeout=args.timeout,
+        seed=args.seed,
+    )
+    line = json.dumps(result)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
+    return 0 if result["requests_failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,205 @@
+"""MicroBatcher semantics: deadline flush, max-batch flush, bounded-queue
+backpressure, per-key exclusion, and drain-on-shutdown. Pure asyncio —
+no jax, no model; tier-1 CPU.
+
+Each test drives the batcher inside `asyncio.run` (no pytest-asyncio
+dependency). Timing assertions use generous windows for CI jitter.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
+
+
+class RecordingProcessor:
+    """process_fn that records every batch it receives."""
+
+    def __init__(self, delay_s=0.0):
+        self.batches = []
+        self.delay_s = delay_s
+
+    def __call__(self, items):
+        self.batches.append(list(items))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [f"r:{item}" for item in items]
+
+
+def test_max_batch_flush():
+    """8 requests against max_batch=4 and a long deadline flush as 4+4 —
+    a full batch never waits for the deadline."""
+    proc = RecordingProcessor()
+
+    async def run():
+        batcher = MicroBatcher(proc, max_batch=4, max_delay_s=5.0)
+        await batcher.start()
+        t0 = time.perf_counter()
+        results = await asyncio.gather(
+            *[batcher.submit(i) for i in range(8)]
+        )
+        elapsed = time.perf_counter() - t0
+        await batcher.drain()
+        return results, elapsed
+
+    results, elapsed = asyncio.run(run())
+    assert results == [f"r:{i}" for i in range(8)]
+    assert elapsed < 2.0  # did not wait out the 5 s deadline
+    assert [len(batch) for batch in proc.batches] == [4, 4]
+
+
+def test_deadline_flush_partial_batch():
+    """Below max_batch, requests flush together once the deadline expires."""
+    proc = RecordingProcessor()
+    deadline = 0.05
+
+    async def run():
+        batcher = MicroBatcher(proc, max_batch=64, max_delay_s=deadline)
+        await batcher.start()
+        t0 = time.perf_counter()
+        results = await asyncio.gather(batcher.submit("a"), batcher.submit("b"))
+        elapsed = time.perf_counter() - t0
+        await batcher.drain()
+        return results, elapsed
+
+    results, elapsed = asyncio.run(run())
+    assert results == ["r:a", "r:b"]
+    assert elapsed >= deadline * 0.8  # waited for batchmates
+    assert elapsed < 5.0
+    assert [len(batch) for batch in proc.batches] == [2]
+
+
+def test_bounded_queue_backpressure():
+    """With the worker busy, the queue admits exactly max_queue requests
+    and rejects the next with BusyError."""
+    release = threading.Event()
+    started = None  # asyncio.Event created inside the loop
+
+    def blocking_proc(items):
+        loop.call_soon_threadsafe(started.set)
+        release.wait(timeout=10)
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        nonlocal started, loop
+        loop = asyncio.get_running_loop()
+        started = asyncio.Event()
+        batcher = MicroBatcher(
+            blocking_proc, max_batch=1, max_delay_s=0.0, max_queue=2
+        )
+        await batcher.start()
+        first = asyncio.ensure_future(batcher.submit("head"))
+        await started.wait()  # worker holds "head" in the executor
+        queued = [asyncio.ensure_future(batcher.submit(i)) for i in range(2)]
+        await asyncio.sleep(0)  # let the submits enqueue
+        with pytest.raises(BusyError):
+            await batcher.submit("overflow")
+        assert batcher.qsize() == 2
+        release.set()
+        results = await asyncio.gather(first, *queued)
+        await batcher.drain()
+        return results
+
+    loop = None
+    results = asyncio.run(run())
+    assert results == ["r:head", "r:0", "r:1"]
+
+
+def test_drain_flushes_queued_requests():
+    """drain() completes every admitted request, then rejects new ones."""
+    proc = RecordingProcessor(delay_s=0.01)
+
+    async def run():
+        batcher = MicroBatcher(proc, max_batch=2, max_delay_s=5.0)
+        await batcher.start()
+        pending = [asyncio.ensure_future(batcher.submit(i)) for i in range(5)]
+        await asyncio.sleep(0)  # enqueue before the drain flag flips
+        await batcher.drain()
+        results = await asyncio.gather(*pending)
+        with pytest.raises(DrainingError):
+            await batcher.submit("late")
+        return results
+
+    results = asyncio.run(run())
+    assert results == [f"r:{i}" for i in range(5)]
+    # Drain ignores the deadline: everything flushed in max_batch chunks.
+    assert sum(len(batch) for batch in proc.batches) == 5
+
+
+def test_batch_key_excludes_duplicates():
+    """Two requests with one key never share a batch (a session's rolling
+    state steps one observation at a time), and stay FIFO per key."""
+    proc = RecordingProcessor()
+
+    async def run():
+        batcher = MicroBatcher(
+            proc,
+            max_batch=8,
+            max_delay_s=0.02,
+            batch_key=lambda item: item[0],
+        )
+        await batcher.start()
+        items = [("a", 0), ("b", 0), ("a", 1), ("a", 2)]
+        results = await asyncio.gather(
+            *[batcher.submit(item) for item in items]
+        )
+        await batcher.drain()
+        return results
+
+    results = asyncio.run(run())
+    assert results == [f"r:{item}" for item in [("a", 0), ("b", 0), ("a", 1), ("a", 2)]]
+    for batch in proc.batches:
+        keys = [key for key, _ in batch]
+        assert len(keys) == len(set(keys)), batch
+    # Per-key order preserved across batches.
+    a_seq = [i for batch in proc.batches for key, i in batch if key == "a"]
+    assert a_seq == [0, 1, 2]
+
+
+def test_process_error_propagates_to_submitters():
+    def failing_proc(items):
+        raise RuntimeError("device fell over")
+
+    async def run():
+        batcher = MicroBatcher(failing_proc, max_batch=4, max_delay_s=0.01)
+        await batcher.start()
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await batcher.submit("x")
+        # The worker survives a failing batch and serves the next one.
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await batcher.submit("y")
+        await batcher.drain()
+
+    asyncio.run(run())
+
+
+def test_cancelled_submit_dropped_before_processing():
+    """A submitter that gives up (HTTP bridge timeout) has its queued
+    request dropped at flush time — no work for a dead client."""
+    proc = RecordingProcessor()
+
+    async def run():
+        batcher = MicroBatcher(proc, max_batch=4, max_delay_s=0.05)
+        await batcher.start()
+        doomed = asyncio.ensure_future(batcher.submit("doomed"))
+        await asyncio.sleep(0)  # enqueue before cancelling
+        doomed.cancel()
+        result = await batcher.submit("live")
+        await batcher.drain()
+        return result
+
+    result = asyncio.run(run())
+    assert result == "r:live"
+    assert proc.batches == [["live"]]  # "doomed" never reached process_fn
+
+
+def test_submit_before_start_raises():
+    async def run():
+        batcher = MicroBatcher(lambda items: items)
+        with pytest.raises(RuntimeError, match="not started"):
+            await batcher.submit("x")
+
+    asyncio.run(run())

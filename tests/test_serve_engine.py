@@ -1,0 +1,265 @@
+"""PolicyEngine end-to-end on the tiny config model (CPU, tier-1).
+
+The load-bearing claim: two sessions interleaved through ONE batched,
+AOT-compiled step produce the same actions as two independent
+`RT1EvalPolicy` instances stepping alone — per-slot rolling state
+(including each slot's own seq_idx roll phase) is exactly the batch-1
+semantics, and the whole run costs exactly one XLA compile of the
+batched step.
+"""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.eval.embedding import HashInstructionEmbedder
+from rt1_tpu.eval.policy import RT1EvalPolicy
+from rt1_tpu.serve.engine import PolicyEngine, SessionError
+
+H, W, D = 32, 56, 512
+T = 3
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    import jax
+
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from tests.test_rt1 import tiny_policy
+
+    model = tiny_policy(time_sequence_length=T)
+    rng = jax.random.PRNGKey(0)
+    obs = {
+        "image": np.zeros((1, T, H, W, 3), np.float32),
+        "natural_language_embedding": np.zeros((1, T, D), np.float32),
+    }
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 1), (1, T)
+    )
+    variables = model.init(
+        {"params": rng, "crop": rng}, obs, actions, train=False
+    )
+    return model, variables
+
+
+def _obs_stream(seed, steps):
+    rng = np.random.default_rng(seed)
+    emb = rng.standard_normal(D).astype(np.float32)
+    return [
+        {
+            "image": rng.random((H, W, 3), dtype=np.float32),
+            "natural_language_embedding": emb,
+        }
+        for _ in range(steps)
+    ]
+
+
+def _history_obs(obs):
+    """Wrap an engine obs as the history-stacked dict RT1EvalPolicy eats."""
+    return {
+        "rgb_sequence": obs["image"][None],
+        "natural_language_embedding": obs["natural_language_embedding"][None],
+    }
+
+
+def test_interleaved_sessions_match_independent_policies(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=4)
+    # Independent single-stream references (each its own max_sessions=1
+    # engine — the refactored RT1EvalPolicy).
+    ref_a = RT1EvalPolicy(model, variables)
+    ref_b = RT1EvalPolicy(model, variables)
+
+    steps = 5  # crosses the T=3 boundary: both roll phases exercised
+    stream_a = _obs_stream(1, steps)
+    stream_b = _obs_stream(2, steps)
+    engine.reset("a")
+    engine.reset("b")
+    for step in range(steps):
+        # One true batched step for both sessions...
+        batched = engine.act_batch(
+            [("a", stream_a[step]), ("b", stream_b[step])]
+        )
+        # ...compared against each reference stepping alone.
+        expected_a = ref_a.action(_history_obs(stream_a[step]))
+        expected_b = ref_b.action(_history_obs(stream_b[step]))
+        np.testing.assert_allclose(
+            batched[0]["action"], expected_a, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            batched[1]["action"], expected_b, atol=1e-5
+        )
+    # Rolling windows advanced per-slot and saturate at T.
+    assert int(engine.session_state("a")["seq_idx"]) == T
+    assert int(engine.session_state("b")["seq_idx"]) == T
+    # The acceptance bar: exactly one XLA compile of the batched step,
+    # regardless of batch composition (2 active here, 1 active in the
+    # references' engines is their own single compile).
+    assert engine.compile_count == 1
+
+
+def test_partial_batches_and_reset_isolation(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=4)
+    stream_a = _obs_stream(3, 3)
+    stream_b = _obs_stream(4, 3)
+    engine.act_batch([("a", stream_a[0]), ("b", stream_b[0])])
+    # A solo step for "b" must not advance "a"'s window (active-mask gating).
+    before_a = engine.session_state("a")
+    engine.act("b", stream_b[1])
+    after_a = engine.session_state("a")
+    assert int(before_a["seq_idx"]) == int(after_a["seq_idx"]) == 1
+    np.testing.assert_array_equal(
+        before_a["context_image_tokens"], after_a["context_image_tokens"]
+    )
+    assert int(engine.session_state("b")["seq_idx"]) == 2
+    # Reset zeroes one slot, leaves the other alone.
+    engine.reset("b")
+    assert int(engine.session_state("b")["seq_idx"]) == 0
+    assert not engine.session_state("b")["context_image_tokens"].any()
+    assert int(engine.session_state("a")["seq_idx"]) == 1
+    assert engine.compile_count == 1
+
+
+def test_reset_matches_fresh_policy(tiny_setup):
+    """After reset, a session replays exactly like a fresh single policy."""
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    stream = _obs_stream(5, 2)
+    engine.reset("s")
+    engine.act("s", stream[0])
+    engine.act("s", stream[1])
+    engine.reset("s")
+    replay = [engine.act("s", obs)["action"] for obs in stream]
+
+    fresh = RT1EvalPolicy(model, variables)
+    expected = [fresh.action(_history_obs(obs)) for obs in stream]
+    np.testing.assert_allclose(replay[0], expected[0], atol=1e-5)
+    np.testing.assert_allclose(replay[1], expected[1], atol=1e-5)
+
+
+def test_lru_slot_reclaim(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    obs = _obs_stream(6, 1)[0]
+    # First contact reports a fresh window; a continuing step does not.
+    assert engine.act("a", obs)["session_started"] is True
+    assert engine.act("a", obs)["session_started"] is False
+    engine.act("b", obs)
+    assert sorted(engine.session_ids()) == ["a", "b"]
+    assert engine.evictions == 0
+    # Third session reclaims the least-recently-used slot ("a").
+    engine.act("c", obs)
+    assert engine.evictions == 1
+    assert sorted(engine.session_ids()) == ["b", "c"]
+    # The reclaimed slot was zeroed for its new owner.
+    assert int(engine.session_state("c")["seq_idx"]) == 1
+    with pytest.raises(SessionError, match="unknown session"):
+        engine.session_state("a")
+    # Touching "b" refreshes it; the next newcomer evicts "c" instead.
+    engine.act("b", obs)
+    engine.act("d", obs)
+    assert sorted(engine.session_ids()) == ["b", "d"]
+
+
+def test_reclaim_never_evicts_batchmate(tiny_setup):
+    """A newcomer in a mixed batch reclaims the LRU *outside* the batch:
+    a session being stepped right now must keep its rolling state."""
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    obs = _obs_stream(15, 1)[0]
+    engine.act("a", obs)  # LRU after b acts
+    engine.act("b", obs)
+    # Batch [(c, .), (a, .)]: c needs a slot; the victim must be b, not
+    # the batchmate a (whose seq_idx advances to 2, state intact).
+    results = engine.act_batch([("c", obs), ("a", obs)])
+    assert all("action" in result for result in results)
+    assert sorted(engine.session_ids()) == ["a", "c"]
+    assert int(engine.session_state("a")["seq_idx"]) == 2
+    assert int(engine.session_state("c")["seq_idx"]) == 1
+
+
+
+def test_release_frees_slot(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    obs = _obs_stream(7, 1)[0]
+    engine.act("a", obs)
+    engine.release("a")
+    assert engine.active_sessions == 0
+    with pytest.raises(SessionError):
+        engine.release("a")
+
+
+def test_duplicate_session_in_batch_rejected(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=4)
+    obs = _obs_stream(8, 1)[0]
+    with pytest.raises(SessionError, match="duplicate"):
+        engine.act_batch([("a", obs), ("a", obs)])
+
+
+def test_oversized_batch_rejected(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=1)
+    obs = _obs_stream(9, 1)[0]
+    with pytest.raises(SessionError, match="exceeds max_sessions"):
+        engine.act_batch([("a", obs), ("b", obs)])
+
+
+def test_fixed_shape_contract(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    engine.act("a", _obs_stream(10, 1)[0])
+    bad = {
+        "image": np.zeros((H + 2, W, 3), np.float32),
+        "natural_language_embedding": np.zeros(D, np.float32),
+    }
+    with pytest.raises(ValueError, match="!= compiled"):
+        engine.act("a", bad)
+    assert engine.compile_count == 1  # no silent recompile
+    # A bad item in a mixed batch errors alone — its batchmate still steps.
+    good = _obs_stream(10, 2)[1]
+    results = engine.act_batch([("a", good), ("b", bad)])
+    assert "action" in results[0]
+    assert isinstance(results[1]["error"], ValueError)
+    assert engine.compile_count == 1
+
+
+def test_instruction_embedding_lru_cache(tiny_setup):
+    model, variables = tiny_setup
+    calls = []
+    base = HashInstructionEmbedder()
+
+    def counting_embedder(text):
+        calls.append(text)
+        return base(text)
+
+    engine = PolicyEngine(
+        model, variables, max_sessions=2, embedder=counting_embedder
+    )
+    image = _obs_stream(11, 1)[0]["image"]
+    engine.act("a", {"image": image, "instruction": "push the red moon"})
+    # Same tokenization (CLIP BPE lowercases and collapses whitespace) —
+    # the cache key is the token ids, so the embedder is skipped.
+    engine.act("a", {"image": image, "instruction": "Push  the red MOON"})
+    assert calls == ["push the red moon"]
+    assert engine.embed_calls == 1
+    engine.act("a", {"image": image, "instruction": "a different command"})
+    assert len(calls) == 2
+
+    # Without an embedder, instruction requests fail loudly.
+    bare = PolicyEngine(model, variables, max_sessions=1)
+    with pytest.raises(SessionError, match="no embedder"):
+        bare.act("x", {"image": image, "instruction": "hi"})
+
+
+def test_warmup_is_the_only_compile(tiny_setup):
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2)
+    engine.warmup((H, W, 3), embed_dim=D)
+    assert engine.compile_count == 1
+    engine.act("a", _obs_stream(12, 1)[0])
+    engine.act_batch(
+        [("a", _obs_stream(13, 1)[0]), ("b", _obs_stream(14, 1)[0])]
+    )
+    assert engine.compile_count == 1

@@ -1,0 +1,220 @@
+"""HTTP serving stack end-to-end on CPU: ThreadingHTTPServer + asyncio
+micro-batcher + batched engine, driven by the real load generator
+(`scripts/serve_loadgen.py` imported from its file path).
+
+Covers the ISSUE acceptance bar in-process: >= 8 concurrent synthetic
+sessions against the tiny model, exactly one XLA compile of the batched
+step, loadgen JSON valid with mean batch occupancy > 1, plus endpoint
+semantics (healthz/metrics/reset/errors) and graceful drain.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rt1_tpu.eval.embedding import HashInstructionEmbedder
+from rt1_tpu.serve import PolicyEngine, ServeApp, make_server
+
+H, W, D = 32, 56, 512
+T = 3
+
+
+def _load_loadgen():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "serve_loadgen.py",
+    )
+    spec = importlib.util.spec_from_file_location("serve_loadgen", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def serving_stack():
+    import jax
+
+    from rt1_tpu.specs import language_table_action_space, sample_space
+    from tests.test_rt1 import tiny_policy
+
+    model = tiny_policy(time_sequence_length=T)
+    rng = jax.random.PRNGKey(0)
+    obs = {
+        "image": np.zeros((1, T, H, W, 3), np.float32),
+        "natural_language_embedding": np.zeros((1, T, D), np.float32),
+    }
+    actions = sample_space(
+        language_table_action_space(), jax.random.fold_in(rng, 1), (1, T)
+    )
+    variables = model.init(
+        {"params": rng, "crop": rng}, obs, actions, train=False
+    )
+    engine = PolicyEngine(
+        model,
+        variables,
+        max_sessions=8,
+        embedder=HashInstructionEmbedder(),
+    )
+    app = ServeApp(
+        engine,
+        image_shape=(H, W, 3),
+        embed_dim=D,
+        # A wider deadline than production's 10 ms keeps occupancy > 1
+        # robust on a loaded CI box; the batch still flushes early at 8.
+        max_delay_s=0.05,
+        max_queue=64,
+    )
+    app.start(warmup=True)
+    httpd = make_server(app, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield app, engine, httpd, url
+    if not app.draining:
+        app.drain()
+    httpd.shutdown()
+    httpd.server_close()
+    thread.join(timeout=5)
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_healthz_reports_contract(serving_stack):
+    app, engine, _, url = serving_stack
+    status, body = _get(url + "/healthz")
+    assert status == 200
+    assert body["status"] == "ok"
+    assert body["image_shape"] == [H, W, 3]
+    assert body["max_sessions"] == 8
+    assert body["compile_count"] == 1  # AOT warmup already done
+
+
+def test_act_and_reset_roundtrip(serving_stack):
+    _, engine, _, url = serving_stack
+    status, body = _post(url + "/reset", {"session_id": "rt"})
+    assert status == 200 and body["ok"]
+    frame = np.zeros((H, W, 3), np.float32)
+    status, body = _post(
+        url + "/act",
+        {
+            "session_id": "rt",
+            "image": frame.tolist(),
+            "instruction": "push the red moon to the blue cube",
+        },
+    )
+    assert status == 200
+    action = np.asarray(body["action"], np.float32)
+    assert action.shape == (2,)
+    assert (np.abs(action) <= 0.03 + 1e-9).all()
+    assert len(body["action_tokens"]) == 3  # terminate + 2 action dims
+    assert int(engine.session_state("rt")["seq_idx"]) == 1
+    _post(url + "/release", {"session_id": "rt"})
+
+
+def test_act_error_paths(serving_stack):
+    _, _, _, url = serving_stack
+    status, body = _post(url + "/act", {"session_id": "e"})
+    assert status == 400 and "image" in body["error"]
+    frame = np.zeros((H, W, 3), np.float32).tolist()
+    status, body = _post(url + "/act", {"session_id": "e", "image": frame})
+    assert status == 400 and "instruction" in body["error"]
+    status, body = _post(
+        url + "/act",
+        {"session_id": "", "image": frame, "instruction": "x"},
+    )
+    assert status == 400
+    status, body = _post(
+        url + "/act",
+        {
+            "session_id": "e",
+            "image_b64": "AAAA",  # wrong byte count for (H, W, 3)
+            "instruction": "x",
+        },
+    )
+    assert status == 400 and "decodes to" in body["error"]
+    status, body = _post(
+        url + "/act",
+        {"session_id": "e", "image": frame, "embedding": [0.0] * 9},
+    )
+    assert status == 400 and "embedding shape" in body["error"]
+    status, body = _post(url + "/release", {"session_id": "never-seen"})
+    assert status == 404
+    status, body = _get(url + "/nope")
+    assert status == 404
+
+
+def test_loadgen_eight_concurrent_sessions(serving_stack):
+    """The acceptance criterion, in-process: 8 concurrent synthetic
+    sessions, valid loadgen metrics JSON, mean batch occupancy > 1, and
+    still exactly one compile of the batched step."""
+    _, engine, _, url = serving_stack
+    loadgen = _load_loadgen()
+    result = loadgen.run_loadgen(url, sessions=8, steps=6, seed=3)
+    assert json.loads(json.dumps(result)) == result  # JSON-serializable
+    assert result["metric"] == "serve_requests_per_sec"
+    assert result["unit"] == "req/s"
+    assert result["requests_ok"] == 8 * 6
+    assert result["requests_failed"] == 0
+    assert result["value"] > 0
+    assert result["latency_p99_ms"] >= result["latency_p50_ms"] > 0
+    # Micro-batching actually batched: more than one session per step on
+    # average, and at least one full-ish batch happened.
+    assert result["mean_batch_occupancy"] > 1
+    assert result["max_batch_occupancy"] >= 2
+    # One XLA compile total, across warmup + all traffic.
+    assert result["server_compile_count"] == 1
+    assert engine.compile_count == 1
+
+
+def test_metrics_endpoint_accumulates(serving_stack):
+    _, _, _, url = serving_stack
+    status, body = _get(url + "/metrics")
+    assert status == 200
+    assert body["requests_total"] > 0
+    assert body["batches_total"] > 0
+    assert body["mean_batch_occupancy"] > 0
+    assert body["latency_p50_ms"] > 0
+    assert body["compile_count"] == 1
+    assert 0 <= body["active_sessions"] <= 8
+
+
+def test_drain_rejects_new_work(serving_stack):
+    """Runs last (name-independent: fixtures are module-scoped, and this
+    mutates app state — keep it after the traffic tests)."""
+    app, _, _, url = serving_stack
+    app.drain()
+    status, body = _get(url + "/healthz")
+    assert body["status"] == "draining"
+    frame = np.zeros((H, W, 3), np.float32).tolist()
+    status, body = _post(
+        url + "/act",
+        {"session_id": "z", "image": frame, "instruction": "x"},
+    )
+    assert status == 503 and body["error"] == "draining"
