@@ -60,6 +60,34 @@ def main():
         "--data_dir", default="/tmp/rt1_bench_episodes",
         help="e2e mode: episode cache dir (synthesized on first run).")
     p.add_argument(
+        "--episodes", type=int, default=24,
+        help="e2e mode: corpus size. 24 (default, the historical TPU-metric "
+             "corpus) fits inside the windowed dataset's 64-episode RAM "
+             "cache, hiding per-window episode reloads; sizes above it "
+             "exercise the decode-per-window regime a real corpus (7800 "
+             "episodes) lives in.")
+    p.add_argument("--src_height", type=int, default=180)
+    p.add_argument(
+        "--src_width", type=int, default=320,
+        help="e2e mode: synthetic corpus SOURCE frame size. Default 180x320 "
+             "(the simulator-native size of the historical bench corpus); "
+             "the reference's converted corpus stores 256x456 frames, so "
+             "--src_height 256 --src_width 456 reproduces its per-window "
+             "decode bill. Non-default sizes get their own corpus dir.")
+    p.add_argument(
+        "--packed", action="store_true",
+        help="e2e mode: feed from the packed mmap frame cache via the "
+             "sample-ahead feeder (rt1_tpu/data/pack.py + feeder.py) "
+             "instead of the tf.data decode+crop path. The cache is packed "
+             "on first run and reused. Metric gains a '_packed' suffix.")
+    p.add_argument(
+        "--model", default="flagship", choices=["flagship", "tiny"],
+        help="Model under the step: 'flagship' is the reference-parity B3 "
+             "config (the TPU headline); 'tiny' is the CPU-runnable "
+             "tiny-tokenizer config (configs/tiny.py scale) for input-"
+             "pipeline A/Bs on hosts without a chip. Metrics gain a "
+             "'_tiny' suffix so flagship baselines stay clean.")
+    p.add_argument(
         "--attention_impl", default="dense", choices=["dense", "pallas"],
         help="infer mode: attention implementation under test.")
     p.add_argument(
@@ -80,13 +108,17 @@ def main():
                   "loop, no XLA programs to trace)", file=sys.stderr)
         return env_bench(args)
 
+    variant = ("_tiny" if args.model == "tiny" else "") + (
+        "_packed" if args.packed and args.mode == "e2e" else ""
+    )
+
     def no_chip_sentinel(error):
         metric = {
-            "train": ("train_steps_per_sec_per_chip", "steps/s/chip"),
-            "e2e": ("train_steps_per_sec_per_chip_e2e", "steps/s/chip"),
-            "mfu": ("train_step_mfu", "%"),
+            "train": (f"train_steps_per_sec_per_chip{variant}", "steps/s/chip"),
+            "e2e": (f"train_steps_per_sec_per_chip_e2e{variant}", "steps/s/chip"),
+            "mfu": (f"train_step_mfu{variant}", "%"),
             "infer": (
-                f"infer_step_latency_p50_{args.attention_impl}", "ms"
+                f"infer_step_latency_p50_{args.attention_impl}{variant}", "ms"
             ),
         }[args.mode]
         # 0.0 with vs_baseline 0.0 is the "no chip" sentinel for
@@ -161,12 +193,26 @@ def main():
     from rt1_tpu.specs import language_table_action_space, sample_space
     from rt1_tpu.trainer import create_train_state, make_optimizer, make_train_step_fns
 
-    model = RT1Policy(
-        action_space=language_table_action_space(),
-        time_sequence_length=6,
-        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
-        attention_impl=args.attention_impl,
-    )
+    if args.model == "tiny":
+        # The REAL tiny config, not a copy: retuning configs/tiny.py
+        # retunes the '_tiny' bench metrics with it. Only the bench-axis
+        # knobs (seq len to match the e2e window, attention impl, dtype)
+        # are overridden.
+        from rt1_tpu.train.configs import tiny as tiny_config
+        from rt1_tpu.train.train import build_model
+
+        mc = tiny_config.get_config().model
+        mc.time_sequence_length = 6
+        mc.attention_impl = args.attention_impl
+        mc.dtype = args.dtype
+        model = build_model(mc)
+    else:
+        model = RT1Policy(
+            action_space=language_table_action_space(),
+            time_sequence_length=6,
+            dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+            attention_impl=args.attention_impl,
+        )
     rng = jax.random.PRNGKey(0)
     b, t = args.batch, 6
     obs = {
@@ -206,10 +252,14 @@ def main():
         return state, dt
 
     if args.mode == "mfu":
-        return mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop)
+        return mfu_bench(
+            args, fns, state, batch, rng, n_chips, timed_resident_loop, variant
+        )
 
     if args.mode == "e2e":
-        return e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop)
+        return e2e_bench(
+            args, fns, state, rng, n_chips, timed_resident_loop, variant
+        )
 
     # Best-of-N windows: min time ~= noise-free sustained throughput; a
     # mean would charge the chip for tunnel dispatch stragglers.
@@ -221,11 +271,12 @@ def main():
         )
         best_dt = dt if best_dt is None else min(best_dt, dt)
     steps_per_sec_per_chip = args.steps / best_dt / n_chips
-    vs = _vs_baseline(steps_per_sec_per_chip, "train_steps_per_sec_per_chip")
+    metric = f"train_steps_per_sec_per_chip{variant}"
+    vs = _vs_baseline(steps_per_sec_per_chip, metric)
     print(
         json.dumps(
             {
-                "metric": "train_steps_per_sec_per_chip",
+                "metric": metric,
                 "value": round(steps_per_sec_per_chip, 4),
                 "unit": "steps/s/chip",
                 "vs_baseline": vs,
@@ -308,8 +359,10 @@ def _vs_baseline(value, key):
     return round(value / baseline, 4) if baseline else 1.0
 
 
-def _ensure_bench_episodes(data_dir, n_episodes=24, steps_per_episode=40):
-    """Synthesize a cached corpus of native-resolution (180x320) episodes."""
+def _ensure_bench_episodes(
+    data_dir, n_episodes=24, steps_per_episode=40, height=180, width=320
+):
+    """Synthesize a cached corpus of `height`x`width`-source episodes."""
     import glob
     import os
 
@@ -317,6 +370,11 @@ def _ensure_bench_episodes(data_dir, n_episodes=24, steps_per_episode=40):
 
     from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
 
+    if (height, width) != (180, 320):
+        # Non-default source sizes live in their own corpus dir so the
+        # historical 180x320 corpus (and its TPU-metric provenance) stays
+        # untouched.
+        data_dir = data_dir.rstrip("/") + f"_src{height}x{width}"
     paths = sorted(glob.glob(os.path.join(data_dir, "episode_*.npz")))
     if len(paths) >= n_episodes:
         return paths[:n_episodes]
@@ -325,28 +383,75 @@ def _ensure_bench_episodes(data_dir, n_episodes=24, steps_per_episode=40):
     for i in range(n_episodes):
         save_episode(
             os.path.join(data_dir, f"episode_{i}.npz"),
-            generate_synthetic_episode(rng, num_steps=steps_per_episode),
+            generate_synthetic_episode(
+                rng, num_steps=steps_per_episode, height=height, width=width
+            ),
         )
     return sorted(glob.glob(os.path.join(data_dir, "episode_*.npz")))
 
 
-def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
+def _e2e_feed(args, fns):
+    """The host->device batch iterator under test: tf.data or packed."""
+    import os
+
+    from rt1_tpu.data.pipeline import WindowedEpisodeDataset, device_feeder
+
+    paths = _ensure_bench_episodes(
+        args.data_dir,
+        n_episodes=args.episodes,
+        height=args.src_height,
+        width=args.src_width,
+    )
+    if args.packed:
+        import sys
+
+        from rt1_tpu.data import pack as pack_lib
+        from rt1_tpu.data.feeder import SampleAheadFeeder
+
+        corpus_dir = os.path.dirname(paths[0])
+        pack_dir = (
+            corpus_dir.rstrip("/")
+            + f"_packed_{args.height}x{args.width}_n{len(paths)}"
+        )
+        t0 = time.perf_counter()
+        pack_lib.pack_episodes(
+            paths, pack_dir, args.height, args.width, 0.95
+        )
+        print(
+            json.dumps(
+                {
+                    "mode": "pack_detail",
+                    "pack_dir": pack_dir,
+                    "pack_seconds": round(time.perf_counter() - t0, 3),
+                }
+            ),
+            file=sys.stderr,
+        )
+        cache = pack_lib.PackedEpisodeCache(pack_dir, window=6)
+        feeder = SampleAheadFeeder(
+            cache, args.batch, seed=0, num_threads=2, depth=2
+        )
+        return device_feeder(feeder, fns.batch_sharding, depth=2)
+    ds = WindowedEpisodeDataset(
+        paths, window=6, crop_factor=0.95, height=args.height, width=args.width
+    )
+    tfds = ds.as_tf_dataset(batch_size=args.batch, seed=0)
+    return device_feeder(tfds.as_numpy_iterator(), fns.batch_sharding, depth=2)
+
+
+def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop, variant=""):
     """Pipeline-fed steps: host windowing/augment -> uint8 H2D (double-
     buffered) -> device step. The number BASELINE.md's wall-clock north star
     actually cares about; `stall_pct` on stderr is the input-bound fraction.
+    `--packed` swaps the tf.data assembly for the packed mmap cache +
+    sample-ahead feeder. Like train mode, the headline is best-of-N
+    `--windows` (dispatch-noise filtering, round-5 advisor finding).
     """
     import sys
 
     import jax
 
-    from rt1_tpu.data.pipeline import WindowedEpisodeDataset, device_feeder
-
-    paths = _ensure_bench_episodes(args.data_dir)
-    ds = WindowedEpisodeDataset(
-        paths, window=6, crop_factor=0.95, height=args.height, width=args.width
-    )
-    tfds = ds.as_tf_dataset(batch_size=args.batch, seed=0)
-    feed = device_feeder(tfds.as_numpy_iterator(), fns.batch_sharding, depth=2)
+    feed = _e2e_feed(args, fns)
 
     # Warmup compiles the uint8-input step and fills the prefetch queue.
     for i in range(args.warmup):
@@ -357,23 +462,46 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
     # dtype-variant compute delta would masquerade as input stall.
     resident = next(feed)
 
-    # The trace wraps the E2E loop (the mode's headline), and the
-    # compute-only baseline runs untraced so trace overhead can't inflate
-    # dt_compute and understate stall_pct.
-    with _maybe_trace(args.trace_dir):
-        t0 = time.perf_counter()
-        for i in range(args.steps):
-            state, metrics = fns.train_step(
-                state, next(feed), jax.random.fold_in(rng, 100 + i)
-            )
-        jax.block_until_ready(metrics["loss"])
-        dt_e2e = time.perf_counter() - t0
+    # Best-of-N windows (the same noise filter the train headline uses —
+    # min over windows estimates the sustained rate with tunnel-dispatch
+    # stragglers removed). The trace wraps only the first window, and the
+    # compute-only baseline runs untraced, so trace overhead can't inflate
+    # either side of the stall computation.
+    best_dt = None
+    for w in range(max(1, args.windows)):
+        with _maybe_trace(args.trace_dir if w == 0 else ""):
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                state, metrics = fns.train_step(
+                    state, next(feed), jax.random.fold_in(rng, 100 + i)
+                )
+            jax.block_until_ready(metrics["loss"])
+            dt_e2e = time.perf_counter() - t0
+        best_dt = dt_e2e if best_dt is None else min(best_dt, dt_e2e)
 
-    state, dt_compute = timed_resident_loop(state, args.steps, 1, resident=resident)
+    # Compute baseline gets the same best-of-N noise filter as the e2e
+    # loop: a dispatch straggler landing in a single compute window would
+    # inflate dt_compute while best_dt filtered it, understating stall_pct.
+    dt_compute = None
+    for w in range(max(1, args.windows)):
+        state, dt_w = timed_resident_loop(
+            state, args.steps, 1 if w == 0 else 0, resident=resident
+        )
+        dt_compute = dt_w if dt_compute is None else min(dt_compute, dt_w)
 
-    e2e = args.steps / dt_e2e / n_chips
+    # Input-only drain: pull batches with no device step in the loop. This
+    # is the pipeline's own sustained rate — the number the e2e ratio
+    # converges to as the device step shrinks (a TPU step is ~8 ms; on a
+    # CPU device the step dominates and hides most of the input delta).
+    n_drain = args.steps * 2
+    t0 = time.perf_counter()
+    for _ in range(n_drain):
+        next(feed)
+    dt_drain = time.perf_counter() - t0
+
+    e2e = args.steps / best_dt / n_chips
     compute_only = args.steps / dt_compute / n_chips
-    stall_pct = max(0.0, 1.0 - dt_compute / dt_e2e) * 100
+    stall_pct = max(0.0, 1.0 - dt_compute / best_dt) * 100
     print(
         json.dumps(
             {
@@ -381,25 +509,28 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop):
                 "compute_only_steps_per_sec_per_chip": round(compute_only, 4),
                 "e2e_steps_per_sec_per_chip": round(e2e, 4),
                 "input_stall_pct": round(stall_pct, 2),
+                "input_only_batches_per_sec": round(n_drain / dt_drain, 4),
+                "packed": bool(args.packed),
+                "model": args.model,
+                "windows": max(1, args.windows),
             }
         ),
         file=sys.stderr,
     )
+    metric = f"train_steps_per_sec_per_chip_e2e{variant}"
     print(
         json.dumps(
             {
-                "metric": "train_steps_per_sec_per_chip_e2e",
+                "metric": metric,
                 "value": round(e2e, 4),
                 "unit": "steps/s/chip",
-                "vs_baseline": _vs_baseline(
-                    e2e, "train_steps_per_sec_per_chip_e2e"
-                ),
+                "vs_baseline": _vs_baseline(e2e, metric),
             }
         )
     )
 
 
-def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop):
+def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop, variant=""):
     """MFU = measured FLOP/s / peak FLOP/s, with FLOPs from XLA's own cost
     analysis of the compiled train step (fwd+bwd+update, the whole program).
     Peak defaults to a v5e chip's bf16 197 TFLOP/s; override with
@@ -441,10 +572,10 @@ def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop):
     print(
         json.dumps(
             {
-                "metric": "train_step_mfu",
+                "metric": f"train_step_mfu{variant}",
                 "value": round(mfu, 3),
                 "unit": "%",
-                "vs_baseline": _vs_baseline(mfu, "train_step_mfu"),
+                "vs_baseline": _vs_baseline(mfu, f"train_step_mfu{variant}"),
             }
         )
     )

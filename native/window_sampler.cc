@@ -142,4 +142,57 @@ void ws_crop_resize_batch(const uint8_t** frames, const int32_t* boxes,
   for (auto& th : pool) th.join();
 }
 
+// Packed-format gather (rt1_tpu/data/pack.py): frames live as one
+// contiguous (T, ph, pw, 3) uint8 block per episode (an mmap), and a
+// training window is n crops addressed by frame index into that block.
+// The packed geometry makes every crop exactly (out_h, out_w), so the hot
+// path is a threaded strided row-memcpy straight out of the page cache —
+// no decode, no resize, no Python per-frame pointer list. Crops that are
+// NOT already at the output size (crop_factor=None packs, future headroom
+// formats) fall through to the bilinear resample above.
+//
+// base:      start of the (T, ph, pw, 3) uint8 frame block.
+// frame_idx: n int64 frame indices into the block.
+// boxes:     n * 4 int32 (top, left, crop_h, crop_w) in PACKED coords.
+// out:       n * out_h * out_w * 3 uint8.
+void ws_packed_gather(const uint8_t* base, const int64_t* frame_idx,
+                      const int32_t* boxes, int n, int ph, int pw,
+                      uint8_t* out, int out_h, int out_w, int threads) {
+  const int64_t frame_sz = static_cast<int64_t>(ph) * pw * 3;
+  const int64_t out_sz = static_cast<int64_t>(out_h) * out_w * 3;
+  auto work = [&](int i) {
+    const uint8_t* frame = base + frame_idx[i] * frame_sz;
+    const int32_t* b = boxes + i * 4;
+    uint8_t* dst = out + i * out_sz;
+    if (b[2] == out_h && b[3] == out_w) {
+      const uint8_t* src = frame + (static_cast<int64_t>(b[0]) * pw + b[1]) * 3;
+      const int64_t src_stride = static_cast<int64_t>(pw) * 3;
+      const int64_t row_bytes = static_cast<int64_t>(out_w) * 3;
+      for (int y = 0; y < out_h; ++y) {
+        std::memcpy(dst + y * row_bytes, src + y * src_stride, row_bytes);
+      }
+      return;
+    }
+    std::vector<XCoef> xc, yc;
+    compute_coefs(b[3], out_w, xc);
+    compute_coefs(b[2], out_h, yc);
+    crop_resize_one(frame, ph, pw, b[0], b[1], b[2], b[3], dst, out_h, out_w,
+                    xc, yc);
+  };
+  if (threads <= 1 || n <= 1) {
+    for (int i = 0; i < n; ++i) work(i);
+    return;
+  }
+  std::atomic<int> next{0};
+  auto runner = [&]() {
+    for (int i = next.fetch_add(1); i < n; i = next.fetch_add(1)) work(i);
+  };
+  int n_threads = std::min(threads, n);
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads - 1);
+  for (int t = 1; t < n_threads; ++t) pool.emplace_back(runner);
+  runner();
+  for (auto& th : pool) th.join();
+}
+
 }  // extern "C"

@@ -27,4 +27,7 @@ __all__ = [
     "generate_synthetic_episode",
     "WindowedEpisodeDataset",
     "device_feeder",
+    # Packed mmap frame cache + sample-ahead feeder (lazy imports below
+    # keep `import rt1_tpu.data` light): rt1_tpu.data.pack.pack_episodes /
+    # PackedEpisodeCache, rt1_tpu.data.feeder.SampleAheadFeeder.
 ]

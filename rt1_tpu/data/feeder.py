@@ -1,0 +1,266 @@
+"""Sample-ahead feeder: background batch assembly over the packed cache.
+
+The tf.data loader interleaves window assembly with the train loop's own
+host time slice; on a single-core host the two serialize and the device
+starves (the 78% input stall, docs/performance.md). This feeder runs batch
+assembly on background threads against `PackedEpisodeCache` — where a
+window is mmap slices, not decodes, so assembly is memcpy-bound and the
+GIL-free native gather lets N threads genuinely overlap — and parks
+finished batches in a bounded ring of queues. The consumer (the train
+loop, via `data.pipeline.device_feeder`) pops ready uint8 batches and
+spends its host slice only on `jax.device_put`.
+
+Determinism: the batch schedule and every crop draw are functions of
+(seed, epoch, batch-index) only — never of thread count or timing — so two
+feeders with the same seed yield identical batch streams, and a 1-thread
+feeder reproduces an 8-thread one bit-for-bit (pinned in
+tests/test_feeder.py).
+
+Lifecycle: `close()` (or the context manager / garbage collection) stops
+the workers promptly even when queues are full; a finite `num_epochs`
+stream raises StopIteration after exactly
+floor(windows / batch) * num_epochs batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from rt1_tpu.data.pack import PackedEpisodeCache
+
+
+class SampleAheadFeeder:
+    """Iterator of training batch dicts assembled ahead of the consumer.
+
+    Yields the same nested {"observations": ..., "actions": ...} dict as
+    `WindowedEpisodeDataset`'s loaders, with uint8 images.
+    """
+
+    def __init__(
+        self,
+        cache: PackedEpisodeCache,
+        batch_size: int,
+        *,
+        seed: int = 0,
+        shuffle: bool = True,
+        num_epochs: Optional[int] = None,
+        num_threads: int = 2,
+        depth: int = 2,
+        process_index: int = 0,
+        process_count: int = 1,
+        start: bool = True,
+    ):
+        if batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        self.cache = cache
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.num_epochs = num_epochs
+        self.num_threads = max(1, num_threads)
+        self.depth = max(1, depth)
+        self.process_index = process_index
+        self.process_count = process_count
+
+        n_windows = len(cache.index) // process_count + (
+            1 if process_index < len(cache.index) % process_count else 0
+        )
+        self.batches_per_epoch = n_windows // batch_size
+        if self.batches_per_epoch == 0:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds this process's "
+                f"{n_windows} windows"
+            )
+        self.total_batches = (
+            None
+            if num_epochs is None
+            else self.batches_per_epoch * num_epochs
+        )
+
+        meta0 = cache.meta(0)
+        self._embed_dim = int(meta0["instruction"].shape[1])
+        self._action_dim = int(meta0["action"].shape[1])
+
+        self._order_lock = threading.Lock()
+        self._order_memo: Dict[int, np.ndarray] = {}
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._queues = [
+            queue.Queue(maxsize=self.depth) for _ in range(self.num_threads)
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(k,), daemon=True,
+                name=f"rt1-feeder-{k}",
+            )
+            for k in range(self.num_threads)
+        ]
+        self._next_ticket = 0
+        self._started = False
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ schedule
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """This process's window order for `epoch` (thread-count-free).
+
+        Memoized per instance: workers straddle at most two epochs at a
+        time, and the memo keeps the per-epoch shuffle O(n log n) once
+        instead of once per batch. Workers only read the cached arrays.
+        """
+        with self._order_lock:
+            order = self._order_memo.get(epoch)
+            if order is None:
+                order = np.arange(len(self.cache.index))
+                if self.shuffle:
+                    np.random.default_rng([self.seed, epoch]).shuffle(order)
+                order = order[self.process_index :: self.process_count]
+                self._order_memo[epoch] = order
+                for stale in [e for e in self._order_memo if e < epoch - 1]:
+                    del self._order_memo[stale]
+        return order
+
+    def _ticket_indices(self, ticket: int) -> np.ndarray:
+        epoch, b = divmod(ticket, self.batches_per_epoch)
+        order = self._epoch_order(epoch)
+        return order[b * self.batch_size : (b + 1) * self.batch_size]
+
+    def _ticket_rng(self, ticket: int) -> np.random.Generator:
+        # Philox keyed directly on (seed, ticket): counter-based, so
+        # construction is ~10us vs ~500us for default_rng's SeedSequence
+        # entropy pooling — this runs once per batch on the hot path. The
+        # 0x5EED word keeps the stream disjoint from the shuffle rng.
+        key = (self.seed & 0xFFFFFFFFFFFFFFFF) ^ (0x5EED << 48)
+        return np.random.Generator(
+            np.random.Philox(key=np.array([key, ticket], np.uint64))
+        )
+
+    # ------------------------------------------------------------ workers
+
+    def _assemble(self, ticket: int) -> Dict:
+        indices = self._ticket_indices(ticket)
+        rng = self._ticket_rng(ticket)
+        b, w = len(indices), self.cache.window
+        h, wd = self.cache.height, self.cache.width
+        images = np.empty((b, w, h, wd, 3), np.uint8)
+        embeds = np.empty((b, w, self._embed_dim), np.float32)
+        terms = np.empty((b, w), np.int32)
+        actions = np.empty((b, w, self._action_dim), np.float32)
+        self.cache.fill_batch(indices, rng, images, embeds, terms, actions)
+        observations = {
+            "image": images,
+            "natural_language_embedding": embeds,
+        }
+        if self.cache._clip_tokenizer is not None:
+            tokens = np.stack(
+                [
+                    self.cache._episode_clip_tokens(self.cache.index[int(i)][0])
+                    for i in indices
+                ]
+            )
+            observations["instruction_tokenized_clip"] = np.tile(
+                tokens[:, None, :], (1, w, 1)
+            )
+        return {
+            "observations": observations,
+            "actions": {"terminate_episode": terms, "action": actions},
+        }
+
+    def _worker(self, k: int) -> None:
+        ticket = k
+        q = self._queues[k]
+        try:
+            while not self._stop.is_set():
+                if self.total_batches is not None and ticket >= self.total_batches:
+                    return
+                batch = self._assemble(ticket)
+                # Bounded put that stays responsive to close(): a plain
+                # q.put would deadlock a full queue against a consumer gone.
+                while not self._stop.is_set():
+                    try:
+                        q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                ticket += self.num_threads
+        except BaseException as e:  # noqa: BLE001 - re-raised in __next__
+            # A dying worker must not strand the consumer in q.get():
+            # stash the error, flip the stop flag, and let __next__
+            # re-raise it on the train loop's thread (a truncated
+            # frames.bin, a bad clip tokenizer — all surface loudly
+            # instead of hanging training).
+            self._error = e
+            self._stop.set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "SampleAheadFeeder":
+        if not self._started:
+            self._started = True
+            for t in self._threads:
+                t.start()
+        return self
+
+    def close(self) -> None:
+        """Stop workers and join them; the iterator is exhausted after."""
+        self._stop.set()
+        for q in self._queues:
+            # Drain so a worker blocked in put() sees the stop event.
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in self._threads:
+            if t.is_alive():
+                t.join(timeout=5.0)
+
+    def __enter__(self) -> "SampleAheadFeeder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ iteration
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self
+
+    def __next__(self) -> Dict:
+        if not self._started:
+            self.start()
+        if self._stop.is_set():
+            self._raise_or_stop()
+        t = self._next_ticket
+        if self.total_batches is not None and t >= self.total_batches:
+            raise StopIteration
+        q = self._queues[t % self.num_threads]
+        while True:
+            try:
+                batch = q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    self._raise_or_stop()
+        self._next_ticket = t + 1
+        return batch
+
+    def _raise_or_stop(self) -> None:
+        """Re-raise a worker's stashed error on the consumer thread, or end
+        the stream cleanly when the stop came from close()."""
+        if self._error is not None:
+            raise RuntimeError(
+                "sample-ahead feeder worker failed"
+            ) from self._error
+        raise StopIteration

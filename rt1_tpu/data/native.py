@@ -152,6 +152,22 @@ def get_window_sampler() -> Optional[ctypes.CDLL]:
             ctypes.c_int,
             ctypes.c_int,
         ]
+        # ws_packed_gather is absent from .so files built before the packed
+        # cache landed; probe so a stale prebuilt library degrades to the
+        # Python gather instead of an AttributeError mid-training.
+        if hasattr(lib, "ws_packed_gather"):
+            lib.ws_packed_gather.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+            ]
         _ws_lib = lib
         return _ws_lib
 
@@ -161,6 +177,68 @@ def sampler_available() -> bool:
         not os.environ.get("RT1_TPU_NO_NATIVE")
         and get_window_sampler() is not None
     )
+
+
+def packed_gather_available() -> bool:
+    """True when the built sampler exports the packed-format gather."""
+    return sampler_available() and hasattr(
+        get_window_sampler(), "ws_packed_gather"
+    )
+
+
+def packed_gather(
+    frames: np.ndarray,
+    frame_idx: np.ndarray,
+    boxes: np.ndarray,
+    out: np.ndarray,
+    threads: int = 0,
+) -> np.ndarray:
+    """Gather n crops out of a packed (T, ph, pw, 3) uint8 frame block.
+
+    frames: the episode's packed frames (typically an np.memmap);
+    frame_idx: (n,) int64 frame indices; boxes: (n, 4) int32
+    (top, left, crop_h, crop_w) in packed coordinates; out: (n, oh, ow, 3)
+    uint8, written in place and returned. Crops already at (oh, ow) are
+    strided memcpys (the packed-cache hot path); others bilinear-resample
+    with cv2.INTER_LINEAR semantics. GIL-free and threaded like
+    `crop_resize_batch`.
+    """
+    lib = get_window_sampler()
+    if lib is None or not hasattr(lib, "ws_packed_gather"):
+        raise RuntimeError("native packed gather unavailable")
+    if frames.dtype != np.uint8 or frames.ndim != 4 or frames.shape[-1] != 3:
+        raise ValueError(f"frames must be (T, ph, pw, 3) uint8, got "
+                         f"{frames.dtype} {frames.shape}")
+    if out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]:
+        raise ValueError("out must be C-contiguous uint8")
+    n = len(frame_idx)
+    t, ph, pw, _ = frames.shape
+    idx = np.ascontiguousarray(frame_idx, np.int64)
+    if n and (idx.min() < 0 or idx.max() >= t):
+        raise IndexError(f"frame_idx out of range [0, {t})")
+    boxes_arr = np.ascontiguousarray(boxes, np.int32)
+    oh, ow = out.shape[1], out.shape[2]
+    if n and (
+        (boxes_arr[:, 0] < 0).any()
+        or (boxes_arr[:, 1] < 0).any()
+        or (boxes_arr[:, 0] + boxes_arr[:, 2] > ph).any()
+        or (boxes_arr[:, 1] + boxes_arr[:, 3] > pw).any()
+    ):
+        raise IndexError("crop box out of packed-frame bounds")
+    # np.memmap satisfies the buffer protocol; ctypes.data is the mapping.
+    lib.ws_packed_gather(
+        frames.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        boxes_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n,
+        ph,
+        pw,
+        out.ctypes.data_as(ctypes.c_void_p),
+        oh,
+        ow,
+        threads or (os.cpu_count() or 1),
+    )
+    return out
 
 
 def crop_resize_batch(

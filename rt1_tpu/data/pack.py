@@ -1,0 +1,596 @@
+"""Packed mmap frame cache: decode-once episodes at augmentation headroom.
+
+The tf.data path pays the full augmentation bill per *sample*: every window
+re-reads decoded 256x456-class frames and random-resize-crops each one
+(~42 ms/batch on the single-core bench host against an 8 ms device step —
+the 78% input stall in docs/performance.md). The fix is to move every
+per-pixel operation that does not depend on the random crop offset to an
+offline pass:
+
+* `pack_episodes` decodes each episode ONCE and stores its frames resized to
+  the *packed* resolution — the smallest frame from which every random crop
+  of the training distribution can be cut as a pure slice — appended into a
+  single corpus-wide uint8 `frames.bin` (mmap-able, no headers), with the
+  small step-aligned members (action/instruction/flags) concatenated into
+  raw `meta_<member>.npy` files and a JSON manifest carrying geometry,
+  per-episode frame offsets, and source fingerprints. One file per array,
+  not per episode: a 7800-episode corpus costs two open fds and zero
+  per-window parsing (per-episode `.npz` sidecars measured 3.2 ms/load —
+  reintroducing the exact per-sample I/O tax this cache removes).
+* `PackedEpisodeCache` maps `frames.bin` once and assembles a training
+  window as h x w uint8 slices out of the mmap — no decode, no resize, no
+  float math, no handle churn.
+
+Crop-distribution parity (tested in tests/test_packed_cache.py): the random
+box is still drawn by `pipeline._crop_box` in SOURCE-frame coordinates —
+bit-identical draws to the tf.data path for the same rng — then mapped into
+packed coordinates, where it is exactly (height, width) by construction:
+
+    source (H0, W0) -- crop (ch0, cw0) = (int(H0*cf), int(W0*cf)) -> (h, w)
+    packed (ph, pw) = (round(H0*h/ch0), round(W0*w/cw0))
+
+so a ch0-tall source crop spans h packed rows, and the gather is
+`frames[t, top_p:top_p+h, left_p:left_p+w]`. The only pixel-semantics
+difference vs the tf.data path is resize-once-then-slice instead of
+slice-then-resize (the same interpolation family, applied once offline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rt1_tpu.data import episodes as ep_lib
+from rt1_tpu.data.pipeline import _crop_box, crop_resize_frames
+
+MANIFEST_NAME = "pack_manifest.json"
+FRAMES_NAME = "frames.bin"
+FORMAT_VERSION = 2
+# Step-aligned members consolidated into meta_<name>.npy (concatenated over
+# episodes along axis 0, raw .npy so the cache opens them mmap_mode="r").
+META_MEMBERS = ("action", "instruction", "is_first", "is_terminal")
+TEXT_NAME = "meta_instruction_text.npy"
+
+
+# --------------------------------------------------------------------- geometry
+
+
+def crop_size(dim: int, crop_factor: Optional[float]) -> int:
+    """Source-coordinate crop size along one dim (`_crop_box` parity)."""
+    return dim if crop_factor is None else int(dim * crop_factor)
+
+
+def packed_dims(
+    src_h: int,
+    src_w: int,
+    height: int,
+    width: int,
+    crop_factor: Optional[float],
+) -> Tuple[int, int]:
+    """Packed (ph, pw): a `crop_factor` source crop spans exactly (h, w).
+
+    crop_factor None degenerates to (height, width) — the gather is then the
+    whole packed frame.
+    """
+    ch0 = crop_size(src_h, crop_factor)
+    cw0 = crop_size(src_w, crop_factor)
+    ph = int(round(src_h * height / ch0))
+    pw = int(round(src_w * width / cw0))
+    # round() cannot undershoot the slice size by construction (ch0 <= src_h
+    # implies src_h*h/ch0 >= h) except through the 0.5-rounding edge; clamp
+    # so the (h, w) gather slice always fits.
+    return max(ph, height), max(pw, width)
+
+
+def map_box_to_packed(
+    box: Tuple[int, int, int, int],
+    src_h: int,
+    src_w: int,
+    ph: int,
+    pw: int,
+    height: int,
+    width: int,
+) -> Tuple[int, int]:
+    """Source-coordinate crop box -> (top, left) of its (h, w) packed slice."""
+    top, left, ch, cw = box
+    top_p = int(round(top * height / max(ch, 1)))
+    left_p = int(round(left * width / max(cw, 1)))
+    return min(max(top_p, 0), ph - height), min(max(left_p, 0), pw - width)
+
+
+# --------------------------------------------------------------------- packer
+
+
+def _fingerprint(path: str) -> Dict[str, object]:
+    st = os.stat(path)
+    return {"name": os.path.basename(path), "bytes": st.st_size,
+            "mtime": round(st.st_mtime, 3)}
+
+
+def _resize_episode_frames(rgb: np.ndarray, ph: int, pw: int) -> np.ndarray:
+    """(T, H0, W0, 3) uint8 -> (T, ph, pw, 3) uint8, full-frame resize."""
+    t, h0, w0, _ = rgb.shape
+    if (h0, w0) == (ph, pw):
+        return np.ascontiguousarray(rgb)
+    boxes = np.tile(np.array([[0, 0, h0, w0]], np.int32), (t, 1))
+    return crop_resize_frames(list(rgb), boxes, ph, pw)
+
+
+def pack_episodes(
+    paths: Sequence[str],
+    out_dir: str,
+    height: int,
+    width: int,
+    crop_factor: Optional[float],
+    force: bool = False,
+) -> Dict[str, object]:
+    """Decode each episode once, write packed frames + sidecars + manifest.
+
+    Returns the manifest dict. Skips work when `pack_is_fresh` already holds
+    (unless `force`). Source frames must share one (H0, W0) across the
+    corpus — the packed geometry is corpus-wide.
+    """
+    paths = sorted(paths)
+    if not paths:
+        raise ValueError("pack_episodes: no episode paths given")
+    if not force and pack_is_fresh(out_dir, paths, height, width, crop_factor):
+        with open(os.path.join(out_dir, MANIFEST_NAME)) as f:
+            return json.load(f)
+
+    os.makedirs(out_dir, exist_ok=True)
+    src_h = src_w = None
+    episodes: List[Dict[str, object]] = []
+    ph = pw = None
+    meta_parts: Dict[str, List[np.ndarray]] = {k: [] for k in META_MEMBERS}
+    text_parts: List[np.ndarray] = []
+    have_text = True
+    frame_offset = 0
+    text_offset = 0
+    frames_tmp = os.path.join(out_dir, FRAMES_NAME + ".tmp")
+    with open(frames_tmp, "wb") as frames_f:
+        for path in paths:
+            ep = ep_lib.load_episode(path)
+            ep_lib.validate_episode(ep)
+            rgb = np.asarray(ep["rgb"], np.uint8)
+            t, h0, w0, _ = rgb.shape
+            if src_h is None:
+                src_h, src_w = h0, w0
+                ph, pw = packed_dims(src_h, src_w, height, width, crop_factor)
+            elif (h0, w0) != (src_h, src_w):
+                raise ValueError(
+                    f"{path}: source frames {h0}x{w0} differ from corpus "
+                    f"{src_h}x{src_w}; the packed geometry is corpus-wide"
+                )
+            _resize_episode_frames(rgb, ph, pw).tofile(frames_f)
+            for k in META_MEMBERS:
+                meta_parts[k].append(np.asarray(ep[k]))
+            entry = {
+                "steps": int(t),
+                "frame_offset": int(frame_offset),
+                "source": _fingerprint(path),
+            }
+            if have_text and "instruction_text" in ep:
+                text = np.asarray(ep["instruction_text"], np.uint8)
+                text_parts.append(text)
+                entry["text_offset"] = int(text_offset)
+                entry["text_len"] = int(text.shape[0])
+                text_offset += int(text.shape[0])
+            else:
+                # All-or-nothing: a corpus with only some instruction_text
+                # members packs without any (mirrors the tf path, which
+                # KeyErrors per missing episode at clip-token time).
+                have_text = False
+            episodes.append(entry)
+            frame_offset += t
+    os.replace(frames_tmp, os.path.join(out_dir, FRAMES_NAME))
+    for k in META_MEMBERS:
+        _atomic_save_npy(
+            os.path.join(out_dir, f"meta_{k}.npy"),
+            np.concatenate(meta_parts[k], axis=0),
+        )
+    if have_text and text_parts:
+        _atomic_save_npy(
+            os.path.join(out_dir, TEXT_NAME), np.concatenate(text_parts)
+        )
+    else:
+        for e in episodes:
+            e.pop("text_offset", None)
+            e.pop("text_len", None)
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "source": {"height": int(src_h), "width": int(src_w)},
+        "train": {
+            "height": int(height),
+            "width": int(width),
+            "crop_factor": crop_factor,
+        },
+        "packed": {"height": int(ph), "width": int(pw)},
+        "total_steps": int(frame_offset),
+        "has_instruction_text": bool(have_text and text_parts),
+        "episodes": episodes,
+    }
+    tmp = os.path.join(out_dir, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, os.path.join(out_dir, MANIFEST_NAME))
+    return manifest
+
+
+def _atomic_save_npy(path: str, arr: np.ndarray) -> None:
+    tmp = path + ".tmp.npy"  # .npy suffix keeps np.save from appending one
+    np.save(tmp, arr)
+    os.replace(tmp, path)
+
+
+def pack_is_fresh(
+    pack_dir: str,
+    paths: Sequence[str],
+    height: int,
+    width: int,
+    crop_factor: Optional[float],
+) -> bool:
+    """True when `pack_dir` holds a current pack of exactly `paths`.
+
+    Current = same train geometry, same episode basenames in the same order,
+    unchanged source size/mtime fingerprints, all packed files present with
+    the expected byte counts.
+    """
+    manifest_path = os.path.join(pack_dir, MANIFEST_NAME)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if manifest.get("format_version") != FORMAT_VERSION:
+        return False
+    train = manifest.get("train", {})
+    if (
+        train.get("height") != height
+        or train.get("width") != width
+        or train.get("crop_factor") != crop_factor
+    ):
+        return False
+    episodes = manifest.get("episodes", [])
+    paths = sorted(paths)
+    if len(episodes) != len(paths):
+        return False
+    for entry, path in zip(episodes, paths):
+        try:
+            fp = _fingerprint(path)
+        except OSError:
+            return False
+        if entry.get("source") != fp:
+            return False
+    ph = manifest["packed"]["height"]
+    pw = manifest["packed"]["width"]
+    total = manifest.get("total_steps", 0)
+    try:
+        if os.path.getsize(os.path.join(pack_dir, FRAMES_NAME)) != total * ph * pw * 3:
+            return False
+    except OSError:
+        return False
+    for k in META_MEMBERS:
+        if not os.path.exists(os.path.join(pack_dir, f"meta_{k}.npy")):
+            return False
+    if manifest.get("has_instruction_text") and not os.path.exists(
+        os.path.join(pack_dir, TEXT_NAME)
+    ):
+        return False
+    return True
+
+
+# --------------------------------------------------------------------- cache
+
+
+class PackedEpisodeCache:
+    """Window sampler over a packed cache: mmap slices, not decodes.
+
+    Mirrors `WindowedEpisodeDataset`'s sample distribution exactly (same
+    (episode, start) index, same front-padding, `_crop_box` draws in source
+    coordinates) but a window's frames are (h, w) uint8 slices out of ONE
+    corpus-wide frame mmap. `get_window` returns the same nested dict the
+    tf.data path produces; `fill_batch` writes a whole batch straight into
+    caller-provided buffers (the feeder's arrays). Total open handles: the
+    frames mmap + one mmap per meta member, regardless of corpus size —
+    there is no per-episode state to cache or evict.
+    """
+
+    def __init__(self, pack_dir: str, window: int = 6, clip_tokenizer=None):
+        self.pack_dir = pack_dir
+        with open(os.path.join(pack_dir, MANIFEST_NAME)) as f:
+            self.manifest = json.load(f)
+        if self.manifest.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"{pack_dir}: pack format "
+                f"{self.manifest.get('format_version')} != {FORMAT_VERSION} "
+                "— re-pack with scripts/pack_dataset.py"
+            )
+        self.window = window
+        self.height = int(self.manifest["train"]["height"])
+        self.width = int(self.manifest["train"]["width"])
+        self.crop_factor = self.manifest["train"]["crop_factor"]
+        self.src_h = int(self.manifest["source"]["height"])
+        self.src_w = int(self.manifest["source"]["width"])
+        self.packed_h = int(self.manifest["packed"]["height"])
+        self.packed_w = int(self.manifest["packed"]["width"])
+        self.episodes = self.manifest["episodes"]
+        self.total_steps = int(self.manifest["total_steps"])
+        self._clip_tokenizer = clip_tokenizer
+        self._clip_token_cache: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+        # One mapping for every frame in the corpus; the kernel pages in
+        # only what gets sliced.
+        self._frames = np.memmap(
+            os.path.join(pack_dir, FRAMES_NAME),
+            dtype=np.uint8,
+            mode="r",
+            shape=(self.total_steps, self.packed_h, self.packed_w, 3),
+        )
+        # Raw .npy metas opened mmap_mode="r": header parsed once here,
+        # window access is a page-cached fancy-index (the per-episode
+        # .npz sidecars this replaces cost 3.2 ms of zipfile parsing per
+        # load — a per-sample tax at corpus scale).
+        self._meta = {
+            k: np.load(
+                os.path.join(pack_dir, f"meta_{k}.npy"), mmap_mode="r"
+            )
+            for k in META_MEMBERS
+        }
+        self._text = None
+        if self.manifest.get("has_instruction_text"):
+            self._text = np.load(
+                os.path.join(pack_dir, TEXT_NAME), mmap_mode="r"
+            )
+        self._frame_offsets = np.array(
+            [int(e["frame_offset"]) for e in self.episodes], np.int64
+        )
+        self.index: List[Tuple[int, int]] = []
+        for i, entry in enumerate(self.episodes):
+            self.index.extend((i, s) for s in range(int(entry["steps"])))
+
+    def __len__(self) -> int:
+        return len(self.index)
+
+    # ------------------------------------------------------------ file access
+
+    def frames(self, ep_i: int) -> np.ndarray:
+        """(T, ph, pw, 3) uint8 view of episode `ep_i`'s packed frames."""
+        off = int(self._frame_offsets[ep_i])
+        return self._frames[off : off + int(self.episodes[ep_i]["steps"])]
+
+    def meta(self, ep_i: int) -> Dict[str, np.ndarray]:
+        """Step-aligned member views for episode `ep_i` (zero copies)."""
+        off = int(self._frame_offsets[ep_i])
+        end = off + int(self.episodes[ep_i]["steps"])
+        return {k: v[off:end] for k, v in self._meta.items()}
+
+    # ------------------------------------------------------------ sampling
+
+    def draw_box(self, rng: np.random.Generator) -> Tuple[int, int, int, int]:
+        """One source-coordinate crop box — the tf.data path's distribution,
+        drawn by the same `_crop_box` (bit-identical for the same rng)."""
+        return _crop_box(self.src_h, self.src_w, self.crop_factor, rng)
+
+    def draw_packed_offsets(
+        self, rng: np.random.Generator, n: int
+    ) -> np.ndarray:
+        """(n, 2) packed-coordinate (top, left) offsets, drawn vectorized.
+
+        Identical distribution to mapping `draw_box` results one by one
+        (uniform integers over the same source ranges, the same
+        round-and-clip into packed coordinates) but one rng call per axis
+        for the whole batch — the feeder's hot path. Not the same *stream*
+        as per-frame `_crop_box` draws; the byte-parity contract with the
+        tf.data path lives on `get_window`/`gather_frames`, which keep the
+        sequential draw order.
+        """
+        h, w = self.height, self.width
+        ph, pw = self.packed_h, self.packed_w
+        if self.crop_factor is None:
+            return np.zeros((n, 2), np.int32)
+        ch0 = int(self.src_h * self.crop_factor)
+        cw0 = int(self.src_w * self.crop_factor)
+        tops = rng.integers(0, self.src_h - ch0 + 1, size=n)
+        lefts = rng.integers(0, self.src_w - cw0 + 1, size=n)
+        out = np.empty((n, 2), np.int32)
+        # np.rint is round-half-even, matching map_box_to_packed's
+        # int(round(.)) on the scalar path.
+        out[:, 0] = np.clip(np.rint(tops * (h / ch0)), 0, ph - h)
+        out[:, 1] = np.clip(np.rint(lefts * (w / cw0)), 0, pw - w)
+        return out
+
+    def _padded_src(self, start: int, j: int) -> int:
+        """Index into the unpadded episode for step j of the padded window."""
+        pad = self.window - 1
+        k = start + j
+        return 0 if k < pad else k - pad
+
+    def _padded_src_indices(self, start: int) -> np.ndarray:
+        """(window,) int64 unpadded source steps for the whole window."""
+        k = np.arange(start, start + self.window, dtype=np.int64)
+        return np.maximum(k - (self.window - 1), 0)
+
+    def gather_frames(
+        self,
+        ep_i: int,
+        start: int,
+        rng: Optional[np.random.Generator] = None,
+        out: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """(window, h, w, 3) uint8 for window `start` of episode `ep_i`.
+
+        Each frame is an independent random crop; boxes are drawn
+        per-frame in source coordinates with the tf.data path's exact rng
+        consumption order (the byte-parity path — `fill_batch` is the
+        vectorized fast path). `out` lets callers fill a buffer in place.
+        """
+        mm = self.frames(ep_i)
+        h, w = self.height, self.width
+        if out is None:
+            out = np.empty((self.window, h, w, 3), np.uint8)
+        rng = rng or np.random.default_rng()
+        boxes = [self.draw_box(rng) for _ in range(self.window)]
+        use_native = _native_gather_available()
+        if use_native:
+            from rt1_tpu.data import native
+
+            src = np.empty((self.window,), np.int64)
+            pboxes = np.empty((self.window, 4), np.int32)
+            for j in range(self.window):
+                src[j] = self._padded_src(start, j)
+                top_p, left_p = map_box_to_packed(
+                    boxes[j], self.src_h, self.src_w,
+                    self.packed_h, self.packed_w, h, w,
+                )
+                pboxes[j] = (top_p, left_p, h, w)
+            native.packed_gather(mm, src, pboxes, out, threads=1)
+            return out
+        for j in range(self.window):
+            frame = mm[self._padded_src(start, j)]
+            top_p, left_p = map_box_to_packed(
+                boxes[j], self.src_h, self.src_w,
+                self.packed_h, self.packed_w, h, w,
+            )
+            out[j] = frame[top_p : top_p + h, left_p : left_p + w]
+        return out
+
+    def get_window(
+        self, idx: int, rng: Optional[np.random.Generator] = None
+    ) -> Dict[str, Dict[str, np.ndarray]]:
+        """Same nested sample dict as `WindowedEpisodeDataset.get_window`."""
+        ep_i, start = self.index[idx]
+        meta = self.meta(ep_i)
+        images = self.gather_frames(ep_i, start, rng)
+        embeds, actions, terms = [], [], []
+        for j in range(self.window):
+            src = self._padded_src(start, j)
+            embeds.append(meta["instruction"][src])
+            actions.append(meta["action"][src])
+            terms.append(np.int32(bool(meta["is_terminal"][src])))
+        observations = {
+            "image": images,
+            "natural_language_embedding": np.stack(embeds).astype(np.float32),
+        }
+        if self._clip_tokenizer is not None:
+            observations["instruction_tokenized_clip"] = np.tile(
+                self._episode_clip_tokens(ep_i), (self.window, 1)
+            )
+        return {
+            "observations": observations,
+            "actions": {
+                "terminate_episode": np.asarray(terms, np.int32),
+                "action": np.stack(actions).astype(np.float32),
+            },
+        }
+
+    def fill_window(
+        self,
+        idx: int,
+        rng: np.random.Generator,
+        image_out: np.ndarray,
+        embed_out: np.ndarray,
+        term_out: np.ndarray,
+        action_out: np.ndarray,
+    ) -> None:
+        """Assemble window `idx` straight into batch-row buffers (no stack)."""
+        ep_i, start = self.index[idx]
+        meta = self.meta(ep_i)
+        self.gather_frames(ep_i, start, rng, out=image_out)
+        for j in range(self.window):
+            src = self._padded_src(start, j)
+            embed_out[j] = meta["instruction"][src]
+            action_out[j] = meta["action"][src]
+            term_out[j] = int(bool(meta["is_terminal"][src]))
+
+    def fill_batch(
+        self,
+        indices: np.ndarray,
+        rng: np.random.Generator,
+        images: np.ndarray,
+        embeds: np.ndarray,
+        terms: np.ndarray,
+        actions: np.ndarray,
+        threads: int = 1,
+    ) -> None:
+        """Assemble a whole batch into preallocated buffers, vectorized.
+
+        The feeder's hot path: one vectorized crop-offset draw, one global
+        frame-index computation, and ONE native gather call (or a numpy
+        slice loop) for the entire batch against the corpus mmap; meta
+        members fill via one fancy-index each. Crop distribution matches
+        the per-window path (`draw_packed_offsets`); byte-level stream
+        parity with `get_window` is not a goal here — determinism is the
+        feeder's (seed, ticket) contract.
+        """
+        n = len(indices)
+        w = self.window
+        h, wd = self.height, self.width
+        offsets = self.draw_packed_offsets(rng, n * w)
+        # Global frame indices: episode frame offset + padded source step.
+        gidx = np.empty((n, w), np.int64)
+        for i, idx in enumerate(indices):
+            ep_i, start = self.index[int(idx)]
+            gidx[i] = self._frame_offsets[ep_i] + self._padded_src_indices(start)
+        flat_idx = gidx.reshape(-1)
+        if _native_gather_available():
+            from rt1_tpu.data import native
+
+            boxes = np.empty((n * w, 4), np.int32)
+            boxes[:, :2] = offsets
+            boxes[:, 2] = h
+            boxes[:, 3] = wd
+            native.packed_gather(
+                self._frames,
+                flat_idx,
+                boxes,
+                images.reshape(n * w, h, wd, 3),
+                threads=threads,
+            )
+        else:
+            flat_img = images.reshape(n * w, h, wd, 3)
+            for j in range(n * w):
+                top, left = offsets[j]
+                flat_img[j] = self._frames[
+                    flat_idx[j], top : top + h, left : left + wd
+                ]
+        embeds[:] = self._meta["instruction"][gidx]
+        actions[:] = self._meta["action"][gidx]
+        terms[:] = self._meta["is_terminal"][gidx]
+
+    def _episode_clip_tokens(self, ep_i: int) -> np.ndarray:
+        with self._lock:
+            tokens = self._clip_token_cache.get(ep_i)
+        if tokens is None:
+            entry = self.episodes[ep_i]
+            if self._text is None or "text_offset" not in entry:
+                raise KeyError(
+                    f"episode {ep_i} in {self.pack_dir} has no "
+                    "'instruction_text'; re-pack from a corpus collected "
+                    "with a current rt1_tpu.data.collect to use clip_tokens"
+                )
+            off, ln = int(entry["text_offset"]), int(entry["text_len"])
+            text = ep_lib.decode_instruction_text(self._text[off : off + ln])
+            tokens = self._clip_tokenizer.tokenize_text(text)[0].astype(np.int32)
+            with self._lock:
+                self._clip_token_cache[ep_i] = tokens
+        return tokens
+
+
+def _native_gather_available() -> bool:
+    if os.environ.get("RT1_TPU_NO_NATIVE"):
+        return False
+    try:
+        from rt1_tpu.data import native
+
+        return native.packed_gather_available()
+    except Exception:
+        return False
+
+
+def default_pack_dir(data_dir: str, split: str) -> str:
+    """Convention: the packed cache lives next to its split's episodes."""
+    return os.path.join(data_dir, f"{split}_packed")

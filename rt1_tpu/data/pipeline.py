@@ -147,54 +147,8 @@ class WindowedEpisodeDataset:
         }
 
     def _crop_resize_frames(self, frames, boxes) -> np.ndarray:
-        """(window,) frames + crop boxes -> (window, H, W, 3) in image_dtype.
-
-        cv2 (SIMD bilinear, GIL-released) when importable; otherwise the
-        native C++ sampler (native/window_sampler.cc) keeps the pipeline
-        dependency-free. Both follow cv2.INTER_LINEAR half-pixel-center
-        semantics, so the sample distribution matches to +/-1 LSB.
-        Set RT1_TPU_FORCE_NATIVE_SAMPLER=1 to force the native path.
-        """
-        import os
-
-        use_native = bool(os.environ.get("RT1_TPU_FORCE_NATIVE_SAMPLER"))
-        if use_native and frames[0].dtype != np.uint8:
-            raise RuntimeError(
-                "RT1_TPU_FORCE_NATIVE_SAMPLER: the native sampler only "
-                f"handles uint8 frames, got {frames[0].dtype}"
-            )
-        if not use_native:
-            try:
-                import cv2  # noqa: F401
-            except ImportError:
-                if frames[0].dtype != np.uint8:
-                    raise RuntimeError(
-                        "cv2 is unavailable and the native sampler only "
-                        f"handles uint8 frames, got {frames[0].dtype}; "
-                        "install opencv-python"
-                    ) from None
-                use_native = True
-        if use_native:
-            from rt1_tpu.data import native
-
-            if not native.sampler_available():
-                raise RuntimeError(
-                    "Neither cv2 nor the native window sampler is available "
-                    "(build native/ with `make` or install opencv-python)"
-                )
-            # Threads=1: tf.data's parallel map already fans out across
-            # windows; the call releases the GIL so those threads genuinely
-            # run in parallel.
-            out = native.crop_resize_batch(
-                frames, boxes, self.height, self.width, threads=1
-            )
-        else:
-            out = np.stack(
-                [
-                    _cv2_crop_resize(rgb, box, self.height, self.width)
-                    for rgb, box in zip(frames, boxes)
-                ]
-            )
+        """(window,) frames + crop boxes -> (window, H, W, 3) in image_dtype."""
+        out = crop_resize_frames(frames, boxes, self.height, self.width)
         if self.image_dtype == "float32":
             return out.astype(np.float32) / 255.0
         return out
@@ -323,6 +277,54 @@ def _crop_box(
     top = int(rng.integers(0, h - ch + 1))
     left = int(rng.integers(0, w - cw + 1))
     return top, left, ch, cw
+
+
+def crop_resize_frames(frames, boxes, height: int, width: int) -> np.ndarray:
+    """Crop + bilinear-resize a batch of frames -> (n, height, width, 3).
+
+    The one augmentation backend every loader shares (tf.data window
+    assembly, the packed-cache packer, and the sample-ahead feeder's general
+    path all call this), so their pixel semantics agree by construction:
+    cv2 (SIMD bilinear, GIL-released) when importable; otherwise the native
+    C++ sampler (native/window_sampler.cc) keeps the pipeline
+    dependency-free. Both follow cv2.INTER_LINEAR half-pixel-center
+    semantics, so the sample distribution matches to +/-1 LSB.
+    Set RT1_TPU_FORCE_NATIVE_SAMPLER=1 to force the native path.
+    """
+    import os
+
+    use_native = bool(os.environ.get("RT1_TPU_FORCE_NATIVE_SAMPLER"))
+    if use_native and frames[0].dtype != np.uint8:
+        raise RuntimeError(
+            "RT1_TPU_FORCE_NATIVE_SAMPLER: the native sampler only "
+            f"handles uint8 frames, got {frames[0].dtype}"
+        )
+    if not use_native:
+        try:
+            import cv2  # noqa: F401
+        except ImportError:
+            if frames[0].dtype != np.uint8:
+                raise RuntimeError(
+                    "cv2 is unavailable and the native sampler only "
+                    f"handles uint8 frames, got {frames[0].dtype}; "
+                    "install opencv-python"
+                ) from None
+            use_native = True
+    if use_native:
+        from rt1_tpu.data import native
+
+        if not native.sampler_available():
+            raise RuntimeError(
+                "Neither cv2 nor the native window sampler is available "
+                "(build native/ with `make` or install opencv-python)"
+            )
+        # Threads=1: tf.data's parallel map / feeder workers already fan out
+        # across windows; the call releases the GIL so those threads
+        # genuinely run in parallel.
+        return native.crop_resize_batch(frames, boxes, height, width, threads=1)
+    return np.stack(
+        [_cv2_crop_resize(rgb, box, height, width) for rgb, box in zip(frames, boxes)]
+    )
 
 
 def _cv2_crop_resize(rgb: np.ndarray, box, height: int, width: int) -> np.ndarray:

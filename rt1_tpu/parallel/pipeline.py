@@ -35,8 +35,11 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+try:  # jax >= 0.6 promotes shard_map to the top-level namespace
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def stack_layer_params(params: Any, num_layers: int, prefix: str = "layer_") -> Any:
@@ -167,6 +170,18 @@ def pipeline_apply(
         out = jax.lax.psum(out, stage_axis)
         return out.reshape((b_local,) + x_local.shape[1:])
 
+    # XLA:CPU SPMD miscompile guard (jax 0.4.x): a stack/concatenate of
+    # per-layer params resharded straight into P(stage) on a mesh with a >1
+    # second axis SUMS the data-axis replicas into each stage shard (each
+    # stage then sees 2x params on a data=2 mesh). Pinning the stacked tree
+    # to an explicit replicated layout first forces the partitioner to
+    # materialize the value before the stage reshard, which compiles
+    # correctly. Pinned in tests/test_pipeline.py::test_pp_train_step_equals_
+    # dense (the exact failure this masked).
+    repl = NamedSharding(mesh, P())
+    stacked_params = jax.tree.map(
+        lambda p: jax.lax.with_sharding_constraint(p, repl), stacked_params
+    )
     return shard_map(
         local,
         mesh=mesh,

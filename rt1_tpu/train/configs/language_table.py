@@ -105,6 +105,20 @@ def get_config():
     # "rlds_tf" loader (reference input_pipeline_rlds.py:307-317); None =
     # process batches locally.
     config.data.data_service_address = ml_collections.config_dict.placeholder(str)
+    # Packed mmap frame cache (rt1_tpu/data/pack.py): feed training from
+    # pre-decoded frames at augmentation-headroom resolution via the
+    # sample-ahead feeder instead of the tf.data decode+crop path. Build
+    # the cache offline with scripts/pack_dataset.py; a missing/stale cache
+    # falls back to the tf.data path with a warning. Incompatible with
+    # loader="rlds_tf".
+    config.data.packed_cache = False
+    # Override the cache location (default: <data_dir>/<split>_packed).
+    config.data.packed_cache_dir = ml_collections.config_dict.placeholder(str)
+    # Sample-ahead feeder shape: background assembly threads and the
+    # per-thread ready-batch queue depth (total sample-ahead =
+    # threads * depth batches).
+    config.data.feeder_threads = 2
+    config.data.feeder_depth = 2
 
     # Training schedule (reference: 100 epochs x 975 steps at batch 8).
     config.per_host_batch_size = 8

@@ -241,6 +241,65 @@ def synthetic_batches(config, seed=0) -> Iterator:
         yield {"observations": obs, "actions": actions}
 
 
+def _packed_batches(config, split, paths, clip_tokenizer) -> Optional[Iterator]:
+    """Packed-cache feed for `split`, or None to fall back to tf.data.
+
+    The cache must exist and be fresh (same episodes, same geometry —
+    build it with scripts/pack_dataset.py); anything else logs a warning
+    and returns None so training proceeds on the tf.data path rather than
+    training on stale pixels or dying at startup.
+    """
+    from absl import logging
+
+    from rt1_tpu.data import pack as pack_lib
+
+    pack_dir = config.data.get("packed_cache_dir") or pack_lib.default_pack_dir(
+        config.data.data_dir, split
+    )
+    if not pack_lib.pack_is_fresh(
+        pack_dir,
+        paths,
+        config.data.height,
+        config.data.width,
+        config.data.crop_factor,
+    ):
+        logging.warning(
+            "data.packed_cache=True but %s is missing or stale for this "
+            "episode set/geometry — falling back to the '%s' loader. Build "
+            "it with: python scripts/pack_dataset.py --data_dir %s --split "
+            "%s --height %d --width %d --crop_factor %s",
+            pack_dir,
+            config.data.loader,
+            config.data.data_dir,
+            split,
+            config.data.height,
+            config.data.width,
+            config.data.crop_factor,
+        )
+        return None
+    from rt1_tpu.data.feeder import SampleAheadFeeder
+
+    cache = pack_lib.PackedEpisodeCache(
+        pack_dir,
+        window=config.model.time_sequence_length,
+        clip_tokenizer=clip_tokenizer,
+    )
+    logging.info(
+        "packed cache: feeding %s from %s (%d windows, %dx%d packed frames)",
+        split, pack_dir, len(cache), cache.packed_h, cache.packed_w,
+    )
+    return SampleAheadFeeder(
+        cache,
+        config.per_host_batch_size,
+        seed=config.seed,
+        shuffle=split == "train",
+        num_threads=config.data.get("feeder_threads", 2),
+        depth=config.data.get("feeder_depth", 2),
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+
+
 def dataset_batches(config, split="train") -> Iterator:
     """Real data: windowed episode dataset, per-host sharded."""
     import glob
@@ -260,6 +319,12 @@ def dataset_batches(config, split="train") -> Iterator:
             "the rlds_tf graph pipeline does not tokenize instructions"
         )
     if config.data.loader == "rlds_tf":
+        if config.data.get("packed_cache", False):
+            raise ValueError(
+                "data.packed_cache=True is incompatible with loader="
+                "'rlds_tf' (the pure-TF graph cannot read the packed mmap "
+                "store); use loader='tf' or 'numpy'"
+            )
         # Pure-TF windowing pipeline: episodes stream lazily from the npz
         # store (one read per generator pull, bounded host memory) into the
         # same window/crop graph the direct-RLDS path uses
@@ -292,6 +357,13 @@ def dataset_batches(config, split="train") -> Iterator:
     clip_tokenizer = None
     if config.data.get("clip_tokens", False):
         clip_tokenizer = _make_clip_tokenizer(config)
+
+    if config.data.get("packed_cache", False):
+        packed_iter = _packed_batches(config, split, paths, clip_tokenizer)
+        if packed_iter is not None:
+            return packed_iter
+        # else: fall through to the tf.data/numpy path (warned inside).
+
     ds = WindowedEpisodeDataset(
         paths,
         window=config.model.time_sequence_length,

@@ -1,0 +1,94 @@
+"""Offline packer CLI: build the packed mmap frame cache for a split.
+
+Decodes each episode once and writes frames at augmentation-headroom
+resolution into per-episode mmap files (rt1_tpu/data/pack.py), so training
+windows become mmap slices instead of per-sample decode+crop+resize. Run it
+once per (geometry, split); training with `--config.data.packed_cache=True`
+then picks the cache up automatically (and falls back to tf.data, loudly,
+if it is missing or stale).
+
+  python scripts/pack_dataset.py --data_dir /data/lt --split train \
+      --height 256 --width 456 --crop_factor 0.95
+
+Prints one JSON summary line per split (pack geometry, episode/frame
+counts, bytes written, wall time).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument("--data_dir", required=True,
+                   help="Episode store root (contains <split>/episode_*.np*).")
+    p.add_argument("--split", action="append", default=None,
+                   help="Split(s) to pack (repeatable); default: train,val.")
+    p.add_argument("--height", type=int, default=256)
+    p.add_argument("--width", type=int, default=456)
+    p.add_argument("--crop_factor", type=str, default="0.95",
+                   help="Train-time crop factor, or 'none' for full-frame.")
+    p.add_argument("--out_dir", default=None,
+                   help="Cache directory (default <data_dir>/<split>_packed). "
+                        "Only valid with a single --split.")
+    p.add_argument("--force", action="store_true",
+                   help="Re-pack even when the cache is fresh.")
+    args = p.parse_args()
+
+    from rt1_tpu.data import pack as pack_lib
+
+    crop_factor = (
+        None if args.crop_factor.lower() in ("none", "null", "")
+        else float(args.crop_factor)
+    )
+    splits = args.split or ["train", "val"]
+    if args.out_dir and len(splits) != 1:
+        p.error("--out_dir requires exactly one --split")
+
+    rc = 0
+    for split in splits:
+        paths = sorted(
+            glob.glob(os.path.join(args.data_dir, split, "episode_*.np*"))
+        )
+        if not paths:
+            print(json.dumps({"split": split, "error": "no_episodes",
+                              "dir": os.path.join(args.data_dir, split)}))
+            rc = 1
+            continue
+        out_dir = args.out_dir or pack_lib.default_pack_dir(
+            args.data_dir, split
+        )
+        t0 = time.perf_counter()
+        fresh = not args.force and pack_lib.pack_is_fresh(
+            out_dir, paths, args.height, args.width, crop_factor
+        )
+        manifest = pack_lib.pack_episodes(
+            paths, out_dir, args.height, args.width, crop_factor,
+            force=args.force,
+        )
+        dt = time.perf_counter() - t0
+        frames = sum(e["steps"] for e in manifest["episodes"])
+        ph, pw = manifest["packed"]["height"], manifest["packed"]["width"]
+        print(json.dumps({
+            "split": split,
+            "out_dir": out_dir,
+            "episodes": len(manifest["episodes"]),
+            "frames": frames,
+            "packed_hw": [ph, pw],
+            "bytes": frames * ph * pw * 3,
+            "already_fresh": fresh,
+            "seconds": round(dt, 2),
+        }))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -327,13 +327,18 @@ recover_collector() {
   kill_orphan_spawn_workers
   if [ "$shards" -ge 300 ]; then
     log "collector dead with $shards shard episodes — salvaging deal"
-    if env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - <<EOF
+    # Quoted heredoc + argv: the corpus path and noise level reach Python
+    # as arguments, never interpolated into source (a path with a quote or
+    # a mangled DART_NOISE would otherwise become a syntax/injection bug).
+    if env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python - \
+        "$DART_CORPUS/data" "$DART_NOISE" <<'EOF'
 import sys; sys.path.insert(0, ".")
 from rt1_tpu.data.collect import finalize_shards
-print(finalize_shards("$DART_CORPUS/data", embedder="ngram",
+data_dir, noise = sys.argv[1], float(sys.argv[2])
+print(finalize_shards(data_dir, embedder="ngram",
                       reward="block2block", block_mode="BLOCK_4",
                       max_steps=80, image_hw=None, workers=2, seed=0,
-                      exec_noise_std=$DART_NOISE))
+                      exec_noise_std=noise))
 EOF
     then return 0; fi
     # Do NOT fall through to a relaunch: collect_dataset_parallel wipes

@@ -1,0 +1,251 @@
+"""Sample-ahead feeder: determinism, tf.data-path parity, lifecycle.
+
+The spec (rt1_tpu/data/feeder.py): the batch stream is a function of
+(seed, epoch, batch-index) only — thread count and timing must not change a
+single byte — finite epochs exhaust exactly, and close() stops promptly
+from any state. Batch content parity with the existing loaders is pinned
+against `WindowedEpisodeDataset.numpy_batches` (same windows, same padding,
+same labels) and, with augmentation on, via the packed cache's crop-parity
+guarantees (tests/test_packed_cache.py).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data import episodes as ep_lib
+from rt1_tpu.data import pack as pack_lib
+from rt1_tpu.data.feeder import SampleAheadFeeder
+from rt1_tpu.data.pipeline import WindowedEpisodeDataset
+
+SRC_H, SRC_W = 24, 40
+H, W = 16, 28
+WINDOW = 3
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("feeder_corpus")
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(4):
+        p = str(tmp / f"episode_{i}.npz")
+        ep_lib.save_episode(
+            p,
+            ep_lib.generate_synthetic_episode(
+                rng, num_steps=6, height=SRC_H, width=SRC_W
+            ),
+        )
+        paths.append(p)
+    return paths
+
+
+def _cache(tmp_path_factory, paths, crop_factor):
+    out = str(tmp_path_factory.mktemp("packed"))
+    pack_lib.pack_episodes(paths, out, H, W, crop_factor)
+    return pack_lib.PackedEpisodeCache(out, window=WINDOW)
+
+
+@pytest.fixture(scope="module")
+def cache(tmp_path_factory, corpus):
+    return _cache(tmp_path_factory, corpus, 0.95)
+
+
+@pytest.fixture(scope="module")
+def cache_nocrop(tmp_path_factory, corpus):
+    return _cache(tmp_path_factory, corpus, None)
+
+
+def _batches_equal(a, b):
+    np.testing.assert_array_equal(
+        a["observations"]["image"], b["observations"]["image"]
+    )
+    np.testing.assert_array_equal(
+        a["observations"]["natural_language_embedding"],
+        b["observations"]["natural_language_embedding"],
+    )
+    np.testing.assert_array_equal(
+        a["actions"]["terminate_episode"], b["actions"]["terminate_episode"]
+    )
+    np.testing.assert_array_equal(a["actions"]["action"], b["actions"]["action"])
+
+
+def test_feeder_shapes_and_dtypes(cache):
+    with SampleAheadFeeder(cache, 4, seed=0) as f:
+        batch = next(f)
+    img = batch["observations"]["image"]
+    assert img.shape == (4, WINDOW, H, W, 3) and img.dtype == np.uint8
+    assert batch["observations"]["natural_language_embedding"].shape == (4, WINDOW, 512)
+    assert batch["actions"]["terminate_episode"].shape == (4, WINDOW)
+    assert batch["actions"]["action"].shape == (4, WINDOW, 2)
+
+
+def test_feeder_deterministic_across_thread_counts(cache):
+    """1 thread == 3 threads, batch for batch — assembly parallelism is
+    invisible in the stream."""
+    streams = []
+    for n_threads in (1, 3):
+        with SampleAheadFeeder(
+            cache, 4, seed=7, num_epochs=2, num_threads=n_threads
+        ) as f:
+            streams.append(list(f))
+    assert len(streams[0]) == len(streams[1]) > 0
+    for a, b in zip(*streams):
+        _batches_equal(a, b)
+
+
+def test_feeder_restart_reproduces_stream(cache):
+    with SampleAheadFeeder(cache, 4, seed=3, num_epochs=1) as f:
+        first = list(f)
+    with SampleAheadFeeder(cache, 4, seed=3, num_epochs=1) as f:
+        again = list(f)
+    for a, b in zip(first, again):
+        _batches_equal(a, b)
+
+
+def test_feeder_seed_changes_stream(cache):
+    with SampleAheadFeeder(cache, 4, seed=1, num_epochs=1) as f:
+        a = next(f)
+    with SampleAheadFeeder(cache, 4, seed=2, num_epochs=1) as f:
+        b = next(f)
+    assert not np.array_equal(
+        a["observations"]["image"], b["observations"]["image"]
+    )
+
+
+def test_feeder_exhaustion_count(cache):
+    n_windows = len(cache)
+    batch = 4
+    epochs = 3
+    with SampleAheadFeeder(cache, batch, seed=0, num_epochs=epochs) as f:
+        got = sum(1 for _ in f)
+    assert got == (n_windows // batch) * epochs
+    # Exhausted for good — StopIteration, not a hang.
+    assert list(itertools.islice(f, 2)) == []
+
+
+def test_feeder_close_midstream_and_joins(cache):
+    f = SampleAheadFeeder(cache, 4, seed=0, num_threads=2, depth=1)
+    next(f)
+    f.close()
+    assert list(itertools.islice(f, 2)) == []
+    for t in f._threads:
+        assert not t.is_alive()
+    f.close()  # idempotent
+
+
+def test_feeder_worker_error_surfaces_on_consumer(cache, monkeypatch):
+    """A dying worker must raise on the train loop's thread, not strand it
+    in an eternal queue wait."""
+    boom = ValueError("frames.bin ate itself")
+
+    def explode(*a, **k):
+        raise boom
+
+    monkeypatch.setattr(cache, "fill_batch", explode)
+    f = SampleAheadFeeder(cache, 4, seed=0, num_threads=2)
+    with pytest.raises(RuntimeError, match="feeder worker failed") as ei:
+        next(f)
+    assert ei.value.__cause__ is boom
+    f.close()
+
+
+def test_feeder_close_without_consuming(cache):
+    """close() with full queues and nothing consumed must not deadlock."""
+    f = SampleAheadFeeder(cache, 4, seed=0, num_threads=2, depth=1)
+    import time
+
+    time.sleep(0.2)  # let workers fill their queues
+    f.close()
+    for t in f._threads:
+        assert not t.is_alive()
+
+
+def test_feeder_process_sharding_partitions_windows(cache):
+    """Two process shards see disjoint windows covering the full epoch."""
+    seen = []
+    for pi in (0, 1):
+        with SampleAheadFeeder(
+            cache, 2, seed=5, shuffle=False, num_epochs=1,
+            process_index=pi, process_count=2,
+        ) as f:
+            n = sum(1 for _ in f)
+        order = f._epoch_order(0)
+        seen.append(set(order.tolist()))
+        assert n == f.batches_per_epoch
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == set(range(len(cache)))
+
+
+def test_feeder_rejects_oversized_batch(cache):
+    with pytest.raises(ValueError, match="exceeds"):
+        SampleAheadFeeder(cache, len(cache) + 1, start=False)
+
+
+def test_feeder_matches_numpy_loader_without_augmentation(corpus, cache_nocrop):
+    """crop_factor None: the feeder's batches equal the existing numpy
+    loader's byte-for-byte (same windows, same padding, same labels; images
+    resized once by the same backend) — content parity with the tf.data
+    family under a fixed (here: absent) augmentation draw."""
+    ds = WindowedEpisodeDataset(
+        corpus, window=WINDOW, crop_factor=None, height=H, width=W
+    )
+    want = list(
+        itertools.islice(ds.numpy_batches(4, shuffle=False, num_epochs=1), 3)
+    )
+    with SampleAheadFeeder(
+        cache_nocrop, 4, seed=0, shuffle=False, num_epochs=1
+    ) as f:
+        got = list(itertools.islice(f, 3))
+    for a, b in zip(got, want):
+        _batches_equal(a, b)
+
+
+def test_train_dataset_batches_packed_switch(tmp_path, corpus):
+    """train.dataset_batches honors data.packed_cache: fresh cache feeds
+    through the feeder; missing cache falls back to the tf.data path."""
+    jax = pytest.importorskip("jax")
+    del jax
+    from rt1_tpu.train.configs import tiny
+    from rt1_tpu.train.train import dataset_batches
+
+    import os
+    import shutil
+
+    data_dir = str(tmp_path / "store")
+    os.makedirs(os.path.join(data_dir, "train"))
+    for p in corpus:
+        shutil.copy(p, os.path.join(data_dir, "train", os.path.basename(p)))
+    paths = sorted(
+        os.path.join(data_dir, "train", f)
+        for f in os.listdir(os.path.join(data_dir, "train"))
+    )
+
+    config = tiny.get_config()
+    with config.unlocked():
+        config.data.data_dir = data_dir
+        config.data.packed_cache = True
+        config.per_host_batch_size = 2
+    # No pack built yet -> falls back (tf.data path still yields batches).
+    it = dataset_batches(config, "train")
+    assert not isinstance(it, SampleAheadFeeder)
+
+    pack_lib.pack_episodes(
+        paths,
+        pack_lib.default_pack_dir(data_dir, "train"),
+        config.data.height,
+        config.data.width,
+        config.data.crop_factor,
+    )
+    it = dataset_batches(config, "train")
+    assert isinstance(it, SampleAheadFeeder)
+    batch = next(it)
+    assert batch["observations"]["image"].shape == (
+        2,
+        config.model.time_sequence_length,
+        config.data.height,
+        config.data.width,
+        3,
+    )
+    it.close()

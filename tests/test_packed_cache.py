@@ -1,0 +1,260 @@
+"""Packed mmap frame cache: pack round-trip, gather parity, crop distribution.
+
+The contract (rt1_tpu/data/pack.py): packing is decode-once + resize-once to
+augmentation-headroom resolution; a training window gathered from the cache
+must (a) reproduce the packed bytes exactly (mmap slice, no resampling),
+(b) draw its random crops from the *identical* distribution as the tf.data
+path (`pipeline._crop_box` in source coordinates), and (c) — when the train
+geometry aligns packed with source pixels — match `WindowedEpisodeDataset`
+byte-for-byte under the same rng.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data import episodes as ep_lib
+from rt1_tpu.data import pack as pack_lib
+from rt1_tpu.data.pipeline import WindowedEpisodeDataset, _crop_box, crop_resize_frames
+
+SRC_H, SRC_W = 24, 40
+
+
+def _make_corpus(tmp_path, n=3, steps=8):
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(n):
+        p = str(tmp_path / f"episode_{i}.npz")
+        ep = ep_lib.generate_synthetic_episode(
+            rng, num_steps=steps, height=SRC_H, width=SRC_W
+        )
+        ep["instruction_text"] = ep_lib.encode_instruction_text(f"move block {i}")
+        ep_lib.save_episode(p, ep)
+        paths.append(p)
+    return paths
+
+
+# ---------------------------------------------------------------- geometry
+
+
+def test_packed_dims_span_exact_crop():
+    """A crop_factor source crop spans exactly (h, w) packed pixels."""
+    for (sh, sw, h, w, cf) in [
+        (180, 320, 256, 456, 0.95),
+        (24, 40, 32, 56, 0.95),
+        (24, 40, 22, 38, 0.95),
+        (180, 320, 128, 224, 0.9),
+    ]:
+        ph, pw = pack_lib.packed_dims(sh, sw, h, w, cf)
+        assert ph >= h and pw >= w
+        # Every drawn box maps to an in-bounds (h, w) slice.
+        rng = np.random.default_rng(1)
+        for _ in range(50):
+            box = _crop_box(sh, sw, cf, rng)
+            top, left = pack_lib.map_box_to_packed(box, sh, sw, ph, pw, h, w)
+            assert 0 <= top <= ph - h and 0 <= left <= pw - w
+
+
+def test_packed_dims_crop_none_is_train_size():
+    assert pack_lib.packed_dims(180, 320, 64, 96, None) == (64, 96)
+
+
+# ---------------------------------------------------------------- packer
+
+
+def test_pack_roundtrip_gather_equals_decoded_source(tmp_path):
+    """pack -> gather == resize-once(decoded source), byte-exact.
+
+    crop_factor None makes the gather the whole packed frame, so it must
+    equal the packer's resize of the decoded source (computed independently
+    here with the shared `crop_resize_frames` backend).
+    """
+    paths = _make_corpus(tmp_path)
+    out = str(tmp_path / "packed")
+    h, w = 16, 28
+    pack_lib.pack_episodes(paths, out, h, w, None)
+    cache = pack_lib.PackedEpisodeCache(out, window=4)
+    for ep_i, path in enumerate(paths):
+        src = ep_lib.load_episode(path)
+        t = src["rgb"].shape[0]
+        boxes = np.tile(np.array([[0, 0, SRC_H, SRC_W]], np.int32), (t, 1))
+        want = crop_resize_frames(list(src["rgb"]), boxes, h, w)
+        # Window at start=t-1 covers the last `window` real steps unpadded.
+        got = cache.gather_frames(ep_i, t - 1, np.random.default_rng(0))
+        np.testing.assert_array_equal(got, want[t - cache.window :])
+        # Meta members survive the pack untouched.
+        meta = cache.meta(ep_i)
+        for k in ("action", "instruction", "is_first", "is_terminal"):
+            np.testing.assert_array_equal(meta[k], src[k])
+
+
+def test_pack_verbatim_when_geometry_aligns(tmp_path):
+    """h=int(H0*cf), w=int(W0*cf) packs source frames byte-identical."""
+    paths = _make_corpus(tmp_path, n=1)
+    out = str(tmp_path / "packed")
+    h, w = int(SRC_H * 0.95), int(SRC_W * 0.95)
+    manifest = pack_lib.pack_episodes(paths, out, h, w, 0.95)
+    assert manifest["packed"] == {"height": SRC_H, "width": SRC_W}
+    src = ep_lib.load_episode(paths[0])
+    frames = np.fromfile(
+        os.path.join(out, pack_lib.FRAMES_NAME), np.uint8
+    ).reshape(src["rgb"].shape)
+    np.testing.assert_array_equal(frames, src["rgb"])
+
+
+def test_pack_freshness_and_staleness(tmp_path):
+    paths = _make_corpus(tmp_path)
+    out = str(tmp_path / "packed")
+    pack_lib.pack_episodes(paths, out, 16, 28, 0.95)
+    assert pack_lib.pack_is_fresh(out, paths, 16, 28, 0.95)
+    # Different geometry -> stale.
+    assert not pack_lib.pack_is_fresh(out, paths, 16, 28, 0.9)
+    assert not pack_lib.pack_is_fresh(out, paths, 18, 28, 0.95)
+    # Different episode set -> stale.
+    assert not pack_lib.pack_is_fresh(out, paths[:-1], 16, 28, 0.95)
+    # Touched source -> stale; re-pack restores freshness.
+    os.utime(paths[0], (0, 0))
+    assert not pack_lib.pack_is_fresh(out, paths, 16, 28, 0.95)
+    pack_lib.pack_episodes(paths, out, 16, 28, 0.95)
+    assert pack_lib.pack_is_fresh(out, paths, 16, 28, 0.95)
+
+
+def test_pack_rejects_mixed_resolutions(tmp_path):
+    paths = _make_corpus(tmp_path, n=1)
+    rng = np.random.default_rng(9)
+    odd = str(tmp_path / "episode_9.npz")
+    ep_lib.save_episode(
+        odd, ep_lib.generate_synthetic_episode(rng, num_steps=4, height=12, width=20)
+    )
+    with pytest.raises(ValueError, match="corpus-wide"):
+        pack_lib.pack_episodes(paths + [odd], str(tmp_path / "p"), 16, 28, 0.95)
+
+
+# ---------------------------------------------------------------- parity
+
+
+def test_crop_box_distribution_matches_tf_path(tmp_path):
+    """`draw_box` IS `pipeline._crop_box` on source dims: same rng -> same
+    boxes, bit for bit — the packed path cannot drift from the tf.data
+    crop distribution."""
+    paths = _make_corpus(tmp_path, n=1)
+    out = str(tmp_path / "packed")
+    pack_lib.pack_episodes(paths, out, 32, 56, 0.95)
+    cache = pack_lib.PackedEpisodeCache(out, window=3)
+    a, b = np.random.default_rng(42), np.random.default_rng(42)
+    for _ in range(200):
+        assert cache.draw_box(a) == _crop_box(SRC_H, SRC_W, 0.95, b)
+
+
+def test_mapped_offsets_preserve_normalized_distribution(tmp_path):
+    """Packed-coordinate offsets track the source offsets' normalized
+    position to within one packed pixel (rounding), over the full range."""
+    paths = _make_corpus(tmp_path, n=1)
+    out = str(tmp_path / "packed")
+    h, w = 32, 56
+    pack_lib.pack_episodes(paths, out, h, w, 0.95)
+    cache = pack_lib.PackedEpisodeCache(out, window=3)
+    ph, pw = cache.packed_h, cache.packed_w
+    ch0, cw0 = int(SRC_H * 0.95), int(SRC_W * 0.95)
+    rng = np.random.default_rng(3)
+    tops_src, tops_packed = [], []
+    for _ in range(500):
+        box = cache.draw_box(rng)
+        top_p, left_p = pack_lib.map_box_to_packed(
+            box, SRC_H, SRC_W, ph, pw, h, w
+        )
+        if SRC_H - ch0 > 0 and ph - h > 0:
+            assert abs(top_p / (ph - h) - box[0] / (SRC_H - ch0)) <= 1.5 / (ph - h)
+        tops_src.append(box[0])
+        tops_packed.append(top_p)
+    # Full range exercised on both sides (uniform draws, 500 samples).
+    assert min(tops_packed) == 0 and max(tops_packed) == ph - h
+    assert min(tops_src) == 0 and max(tops_src) == SRC_H - ch0
+
+
+def test_window_matches_tf_path_exactly_when_aligned(tmp_path):
+    """Aligned geometry: packed get_window == WindowedEpisodeDataset
+    .get_window byte-for-byte under the same augmentation rng (same crop
+    draws in source coordinates, verbatim packed pixels, identity resize)."""
+    paths = _make_corpus(tmp_path)
+    h, w = int(SRC_H * 0.95), int(SRC_W * 0.95)
+    out = str(tmp_path / "packed")
+    pack_lib.pack_episodes(paths, out, h, w, 0.95)
+    window = 4
+    cache = pack_lib.PackedEpisodeCache(out, window=window)
+    ds = WindowedEpisodeDataset(
+        paths, window=window, crop_factor=0.95, height=h, width=w
+    )
+    assert len(cache) == len(ds)
+    for idx in range(0, len(ds), 3):
+        a = cache.get_window(idx, np.random.default_rng(100 + idx))
+        b = ds.get_window(idx, np.random.default_rng(100 + idx))
+        np.testing.assert_array_equal(
+            a["observations"]["image"], b["observations"]["image"]
+        )
+        np.testing.assert_array_equal(
+            a["observations"]["natural_language_embedding"],
+            b["observations"]["natural_language_embedding"],
+        )
+        np.testing.assert_array_equal(
+            a["actions"]["terminate_episode"], b["actions"]["terminate_episode"]
+        )
+        np.testing.assert_array_equal(
+            a["actions"]["action"], b["actions"]["action"]
+        )
+
+
+# ---------------------------------------------------------------- native
+
+
+@pytest.fixture(scope="module")
+def native_gather():
+    from rt1_tpu.data import native
+
+    if not native.packed_gather_available():
+        pytest.skip(
+            "native packed gather unavailable (build native/ with "
+            "`make packed` or any g++ toolchain)"
+        )
+    return native
+
+
+def test_native_gather_matches_python_fallback(tmp_path, native_gather, monkeypatch):
+    paths = _make_corpus(tmp_path)
+    out = str(tmp_path / "packed")
+    pack_lib.pack_episodes(paths, out, 32, 56, 0.95)
+    cache = pack_lib.PackedEpisodeCache(out, window=5)
+    for idx in (0, 4, len(cache) - 1):
+        ep_i, start = cache.index[idx]
+        a = cache.gather_frames(ep_i, start, np.random.default_rng(idx))
+        monkeypatch.setenv("RT1_TPU_NO_NATIVE", "1")
+        b = cache.gather_frames(ep_i, start, np.random.default_rng(idx))
+        monkeypatch.delenv("RT1_TPU_NO_NATIVE")
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_gather_resample_path(tmp_path, native_gather):
+    """Boxes not at output size fall through to the bilinear resample and
+    match the shared crop_resize backend to +/-1 LSB."""
+    rng = np.random.default_rng(5)
+    frames = rng.integers(0, 256, (3, 20, 30, 3), dtype=np.uint8)
+    idx = np.array([2, 0, 1], np.int64)
+    boxes = np.array([[1, 2, 16, 24]] * 3, np.int32)
+    out = np.empty((3, 8, 12, 3), np.uint8)
+    native_gather.packed_gather(frames, idx, boxes, out, threads=2)
+    want = crop_resize_frames([frames[i] for i in idx], boxes, 8, 12)
+    assert np.max(np.abs(out.astype(int) - want.astype(int))) <= 1
+
+
+def test_native_gather_bounds_checks(native_gather):
+    frames = np.zeros((2, 8, 8, 3), np.uint8)
+    out = np.empty((1, 4, 4, 3), np.uint8)
+    with pytest.raises(IndexError):
+        native_gather.packed_gather(
+            frames, np.array([2], np.int64), np.array([[0, 0, 4, 4]], np.int32), out
+        )
+    with pytest.raises(IndexError):
+        native_gather.packed_gather(
+            frames, np.array([0], np.int64), np.array([[6, 0, 4, 4]], np.int32), out
+        )
