@@ -26,11 +26,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 from rt1_tpu.data.pack import PackedEpisodeCache
+from rt1_tpu.obs import trace as obs_trace
 
 
 class SampleAheadFeeder:
@@ -101,6 +103,11 @@ class SampleAheadFeeder:
         ]
         self._next_ticket = 0
         self._started = False
+        # Per-worker observability counters (rt1_tpu/obs): index-assigned
+        # list writes are GIL-atomic, so workers update lock-free and
+        # `stats()` reads a consistent-enough snapshot for gauges.
+        self._assembled = [0] * self.num_threads
+        self._assembly_s = [0.0] * self.num_threads
         if start:
             self.start()
 
@@ -178,7 +185,13 @@ class SampleAheadFeeder:
             while not self._stop.is_set():
                 if self.total_batches is not None and ticket >= self.total_batches:
                     return
-                batch = self._assemble(ticket)
+                # obs: the span makes this worker's assembly visible on the
+                # shared host timeline; no-op (one global read) untraced.
+                t0 = time.perf_counter()
+                with obs_trace.span("feeder_assemble", ticket=ticket):
+                    batch = self._assemble(ticket)
+                self._assembly_s[k] += time.perf_counter() - t0
+                self._assembled[k] += 1
                 # Bounded put that stays responsive to close(): a plain
                 # q.put would deadlock a full queue against a consumer gone.
                 while not self._stop.is_set():
@@ -187,6 +200,11 @@ class SampleAheadFeeder:
                         break
                     except queue.Full:
                         continue
+                if obs_trace.enabled():
+                    obs_trace.counter(
+                        "feeder_queue_depth",
+                        sum(qq.qsize() for qq in self._queues),
+                    )
                 ticket += self.num_threads
         except BaseException as e:  # noqa: BLE001 - re-raised in __next__
             # A dying worker must not strand the consumer in q.get():
@@ -196,6 +214,27 @@ class SampleAheadFeeder:
             # instead of hanging training).
             self._error = e
             self._stop.set()
+
+    # ---------------------------------------------------------- observability
+
+    def stats(self) -> Dict[str, float]:
+        """Flat numeric gauges for the obs layer (train-side Prometheus
+        listener, flight-recorder step records): ready-queue fill and
+        per-worker assembly counters. Lock-free reads of GIL-atomic
+        counters — safe to call from any thread at any rate."""
+        depth = sum(q.qsize() for q in self._queues)
+        out = {
+            "queue_depth": depth,
+            "queue_capacity": self.num_threads * self.depth,
+            "next_ticket": self._next_ticket,
+        }
+        for k in range(self.num_threads):
+            n = self._assembled[k]
+            out[f"assembled_w{k}"] = n
+            out[f"assembly_ms_mean_w{k}"] = (
+                self._assembly_s[k] / n * 1e3 if n else 0.0
+            )
+        return out
 
     # ------------------------------------------------------------ lifecycle
 

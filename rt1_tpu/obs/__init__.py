@@ -1,0 +1,96 @@
+"""rt1_tpu.obs — unified observability across train, data, and serve.
+
+One subsystem, four pieces, all optional and all cheap when off:
+
+* :mod:`rt1_tpu.obs.trace`      — host-side Chrome-trace span recorder
+  (Perfetto-loadable); train loop, feeder workers, and serve batcher emit
+  into one timeline.
+* :mod:`rt1_tpu.obs.steps`      — `StepTimeline`: per-step wall-time
+  attribution (wait_data / h2d / device_step / host) + the rolling
+  `stall_pct` gauge.
+* :mod:`rt1_tpu.obs.prometheus` — exposition text format + the opt-in
+  scrape listener (`MetricsServer`).
+* :mod:`rt1_tpu.obs.recorder`   — `FlightRecorder`: ring buffer of recent
+  step records, dumped to JSONL on crash/SIGTERM.
+
+Import hygiene is part of the contract: this package (and everything it
+imports at module scope) must not require clu, tensorboard, or tensorflow
+— headless serve deployments scrape `/metrics` without dragging in the
+training stack. `tests/test_obs_imports.py` pins this.
+
+See `docs/observability.md` for the operator guide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from rt1_tpu.obs import prometheus, recorder, steps, trace
+from rt1_tpu.obs.prometheus import MetricsServer
+from rt1_tpu.obs.recorder import FlightRecorder
+from rt1_tpu.obs.steps import StepTimeline
+from rt1_tpu.obs.trace import TraceRecorder
+
+__all__ = [
+    "FlightRecorder",
+    "MetricsServer",
+    "ObsOptions",
+    "StepTimeline",
+    "TraceRecorder",
+    "prometheus",
+    "recorder",
+    "steps",
+    "trace",
+]
+
+
+@dataclasses.dataclass
+class ObsOptions:
+    """Resolved `config.obs` with defaults for configs that predate it.
+
+    The train loop consumes this instead of poking `config.obs.*` directly
+    so pre-obs configs (proof configs, pinned sweep artifacts) keep running
+    unmodified, and so defaults live in exactly one place.
+    """
+
+    trace: bool = False
+    trace_path: Optional[str] = None  # None -> <workdir>/trace.json
+    trace_max_events: int = 200_000
+    stall_window: int = 50
+    sync_timing: bool = False
+    prometheus_port: int = -1  # < 0: no train-side listener; 0: ephemeral
+    prometheus_host: str = "127.0.0.1"
+    flight_recorder: bool = True
+    flight_recorder_size: int = 256
+    flight_recorder_path: Optional[str] = None  # None -> <workdir>/...jsonl
+
+    @classmethod
+    def from_config(cls, config, workdir: Optional[str] = None) -> "ObsOptions":
+        """Read `config.obs` if present (ml_collections or plain mapping);
+        absent keys fall back to the dataclass defaults."""
+        node = None
+        if config is not None:
+            get = getattr(config, "get", None)
+            node = get("obs") if callable(get) else getattr(config, "obs", None)
+        kwargs = {}
+        if node is not None:
+            for field in dataclasses.fields(cls):
+                getter = getattr(node, "get", None)
+                value = (
+                    getter(field.name)
+                    if callable(getter)
+                    else getattr(node, field.name, None)
+                )
+                if value is not None:
+                    kwargs[field.name] = value
+        opts = cls(**kwargs)
+        if workdir:
+            if opts.trace_path is None:
+                opts.trace_path = os.path.join(workdir, "trace.json")
+            if opts.flight_recorder_path is None:
+                opts.flight_recorder_path = os.path.join(
+                    workdir, "flight_record.jsonl"
+                )
+        return opts

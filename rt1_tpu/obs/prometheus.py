@@ -1,0 +1,274 @@
+"""Prometheus text-format exposition (and a tiny scrape listener).
+
+The serve layer's `/metrics` spoke JSON only — fine for
+`scripts/serve_loadgen.py`, invisible to every standard scraper. This
+module renders the exposition format (version 0.0.4: `# HELP`/`# TYPE`
+comments, cumulative `le` histogram buckets ending at `+Inf`, `_sum` and
+`_count` series) from plain Python dicts, so:
+
+* the serve `/metrics` endpoint can content-negotiate: JSON by default,
+  text when the scraper asks (`Accept: text/plain` or openmetrics) —
+  `rt1_tpu/serve/server.py`;
+* the train loop can expose its own scrape target
+  (`config.obs.prometheus_port`) without importing any serving code —
+  `MetricsServer` below is a stdlib `ThreadingHTTPServer` on a daemon
+  thread.
+
+Everything renders FROM the JSON snapshot (`ServeMetrics.snapshot()` now
+carries cumulative bucket counts), so the two formats cannot drift: same
+numbers, two syntaxes.
+
+No third-party dependencies — this module must stay importable in a
+headless serve deployment with no clu/tensorboard installed (pinned by
+`tests/test_obs_imports.py`).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce an arbitrary metric key into a legal Prometheus name."""
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def format_value(v: float) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class TextExposition:
+    """Accumulates metric families and renders the text format."""
+
+    def __init__(self):
+        self._lines: List[str] = []
+        self._seen: set = set()
+
+    def _header(self, name: str, mtype: str, help_text: Optional[str]):
+        if name in self._seen:
+            raise ValueError(f"metric family {name!r} already rendered")
+        self._seen.add(name)
+        if help_text:
+            # Escape per the exposition spec: backslash and newline.
+            escaped = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+            self._lines.append(f"# HELP {name} {escaped}")
+        self._lines.append(f"# TYPE {name} {mtype}")
+
+    def counter(self, name: str, value: float, help_text: str = ""):
+        name = sanitize_name(name)
+        self._header(name, "counter", help_text)
+        self._lines.append(f"{name} {format_value(value)}")
+
+    def gauge(self, name: str, value: float, help_text: str = ""):
+        name = sanitize_name(name)
+        self._header(name, "gauge", help_text)
+        self._lines.append(f"{name} {format_value(value)}")
+
+    def histogram(
+        self,
+        name: str,
+        cumulative: Sequence[Tuple[Any, int]],
+        sum_value: float,
+        count: int,
+        help_text: str = "",
+    ):
+        """`cumulative`: (upper_bound, cumulative_count) pairs in ascending
+        bound order; the final bound may be inf / "+Inf" — if absent, an
+        `+Inf` bucket equal to `count` is appended (the spec requires it)."""
+        name = sanitize_name(name)
+        self._header(name, "histogram", help_text)
+        has_inf = False
+        for le, c in cumulative:
+            if isinstance(le, str):
+                le_str = le
+                has_inf = has_inf or le == "+Inf"
+            else:
+                le_f = float(le)
+                has_inf = has_inf or math.isinf(le_f)
+                le_str = format_value(le_f)
+            self._lines.append(f'{name}_bucket{{le="{le_str}"}} {int(c)}')
+        if not has_inf:
+            self._lines.append(f'{name}_bucket{{le="+Inf"}} {int(count)}')
+        self._lines.append(f"{name}_sum {format_value(sum_value)}")
+        self._lines.append(f"{name}_count {int(count)}")
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
+# --------------------------------------------------------------- renderers
+
+# snapshot() counter keys -> (family suffix, help). Everything else numeric
+# in the snapshot becomes a gauge; *_buckets / *_count / *_sum_s triples
+# become histograms.
+_SERVE_COUNTERS = {
+    "requests_total": "Requests accepted by /act (including failed).",
+    "errors_total": "Requests answered with an error status.",
+    "rejected_total": "Requests shed by queue backpressure (503 busy).",
+    "resets_total": "Session resets via /reset.",
+    "batches_total": "Batched device steps executed.",
+}
+
+_SERVE_HISTOGRAMS = {
+    "latency": ("request_latency_seconds", "Full request wall time."),
+    "step": ("step_latency_seconds", "Batched device step latency."),
+}
+
+
+def render_serve_snapshot(
+    snapshot: Dict[str, Any], prefix: str = "rt1_serve_"
+) -> str:
+    """ServeMetrics JSON snapshot -> Prometheus text, one source of truth."""
+    exp = TextExposition()
+    consumed = set()
+    for key, help_text in _SERVE_COUNTERS.items():
+        if key in snapshot:
+            exp.counter(prefix + key, snapshot[key], help_text)
+            consumed.add(key)
+    for key, (family, help_text) in _SERVE_HISTOGRAMS.items():
+        buckets = snapshot.get(f"{key}_buckets")
+        if buckets is None:
+            continue
+        exp.histogram(
+            prefix + family,
+            buckets,
+            sum_value=snapshot.get(f"{key}_sum_s", 0.0),
+            count=snapshot.get(f"{key}_count", 0),
+            help_text=help_text,
+        )
+        consumed.update({f"{key}_buckets", f"{key}_sum_s", f"{key}_count"})
+    for key in sorted(snapshot.keys() - consumed):
+        value = snapshot[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        name = prefix + key
+        if key == "uptime_s":
+            name = prefix + "uptime_seconds"
+        exp.gauge(name, value)
+    return exp.render()
+
+
+def render_scalar_gauges(
+    scalars: Dict[str, Any], prefix: str = "rt1_train_"
+) -> str:
+    """Flat {name: number} -> all-gauge text (the train-side scrape body).
+
+    Names pass through `sanitize_name` ('timing/wait_data_ms' ->
+    'timing_wait_data_ms'); non-numeric values are skipped.
+    """
+    exp = TextExposition()
+    for key in sorted(scalars):
+        value = scalars[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        exp.gauge(sanitize_name(prefix + key), value)
+    return exp.render()
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def accepts_text(accept_header: Optional[str]) -> bool:
+    """Content negotiation for a dual JSON/text /metrics endpoint: JSON
+    stays the default (existing loadgen/automation), text is returned when
+    the client asks the way Prometheus does.
+
+    Listed order breaks ties (a full q-value parse is overkill here): a
+    client sending ``application/json, text/plain, */*`` — the stock
+    axios/fetch Accept — wants JSON first and gets JSON.
+    """
+    if not accept_header:
+        return False
+    for entry in accept_header.lower().split(","):
+        media = entry.split(";", 1)[0].strip()
+        if media == "application/json":
+            return False
+        if media == "text/plain" or "openmetrics" in media:
+            return True
+    return False
+
+
+# ----------------------------------------------------------------- listener
+
+
+class MetricsServer:
+    """Opt-in scrape listener: GET /metrics -> `render_fn()` as text.
+
+    Stdlib-only, daemon-threaded, ephemeral-port-friendly (port=0). The
+    train loop hands it a closure over its StepTimeline / ThroughputMeter /
+    feeder stats; rendering cost is paid by the scraper's request, never by
+    the train step.
+    """
+
+    def __init__(
+        self,
+        render_fn: Callable[[], str],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102 - stdlib hook
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib casing
+                if self.path == "/metrics":
+                    try:
+                        body = outer._render_fn().encode("utf-8")
+                    except Exception as exc:  # noqa: BLE001 - scrape-safe
+                        body = f"# render error: {exc}\n".encode("utf-8")
+                        self._send(500, body)
+                        return
+                    self._send(200, body)
+                elif self.path == "/healthz":
+                    self._send(200, b"ok\n", content_type="text/plain")
+                else:
+                    self._send(404, b"not found\n", content_type="text/plain")
+
+            def _send(self, code, body, content_type=CONTENT_TYPE):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._render_fn = render_fn
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="rt1-obs-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

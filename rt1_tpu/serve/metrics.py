@@ -58,6 +58,18 @@ class LatencyHistogram:
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0
 
+    def cumulative_counts(self):
+        """Prometheus-style cumulative buckets: ascending (upper_bound,
+        cumulative_count) pairs ending with (inf, count). The per-bucket
+        `counts` stay as-is; this is the exposition view of them."""
+        out = []
+        cumulative = 0
+        for upper, c in zip(self.buckets, self.counts):
+            cumulative += c
+            out.append((upper, cumulative))
+        out.append((float("inf"), self.count))
+        return out
+
 
 class ServeMetrics:
     """Aggregates the serving process's request/batch/session counters."""
@@ -106,9 +118,43 @@ class ServeMetrics:
 
     # ------------------------------------------------------------ reporting
 
+    @staticmethod
+    def _coerce_gauge(name: str, value: Any) -> float:
+        """Validate a caller-supplied gauge: numeric (including numpy/jax
+        scalars) coerces to float; anything else raises, naming the gauge —
+        a typo'd gauge must fail the caller, not vanish from /metrics."""
+        if isinstance(value, bool):
+            return float(value)
+        try:
+            out = float(value)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"gauge {name!r} is not numeric: {value!r} "
+                f"({type(value).__name__})"
+            ) from exc
+        return out
+
+    @staticmethod
+    def _bucket_json(hist: LatencyHistogram):
+        """JSON encoding of `cumulative_counts`: inf -> '+Inf' (strict JSON
+        has no Infinity literal; the Prometheus renderer understands both)."""
+        return [
+            ["+Inf" if le == float("inf") else le, c]
+            for le, c in hist.cumulative_counts()
+        ]
+
     def snapshot(self, **gauges: Any) -> Dict[str, Any]:
         """One flat JSON-serializable dict; extra `gauges` (active_sessions,
-        compile_count, ...) are merged in by the caller that owns them."""
+        compile_count, ...) are merged in by the caller that owns them —
+        validated/coerced and merged under the lock, so a snapshot is one
+        consistent cut even while handler threads record.
+
+        Includes the cumulative histogram bucket counts
+        (`latency_buckets`/`step_buckets` + `*_count`/`*_sum_s`), so the
+        JSON view and the Prometheus exposition — which renders FROM this
+        snapshot — cannot disagree.
+        """
+        coerced = {k: self._coerce_gauge(k, v) for k, v in gauges.items()}
         with self._lock:
             uptime = time.monotonic() - self._started
             out = {
@@ -124,8 +170,14 @@ class ServeMetrics:
                 "latency_p99_ms": self.latency.quantile(0.99) * 1e3,
                 "latency_mean_ms": self.latency.mean() * 1e3,
                 "latency_max_ms": self.latency.max * 1e3,
+                "latency_buckets": self._bucket_json(self.latency),
+                "latency_count": self.latency.count,
+                "latency_sum_s": self.latency.total,
                 "step_p50_ms": self.step_latency.quantile(0.5) * 1e3,
                 "step_p99_ms": self.step_latency.quantile(0.99) * 1e3,
+                "step_buckets": self._bucket_json(self.step_latency),
+                "step_count": self.step_latency.count,
+                "step_sum_s": self.step_latency.total,
                 "batches_total": self.batches_total,
                 "mean_batch_occupancy": (
                     self.occupancy_sum / self.batches_total
@@ -135,15 +187,27 @@ class ServeMetrics:
                 "max_batch_occupancy": self.occupancy_max,
                 "queue_depth": self.queue_depth,
             }
-        out.update(gauges)
+            out.update(coerced)
         return out
+
+    def prometheus_text(self, **gauges: Any) -> str:
+        """The snapshot in Prometheus exposition format (content-negotiated
+        `/metrics` path; see rt1_tpu/obs/prometheus.py)."""
+        from rt1_tpu.obs.prometheus import render_serve_snapshot
+
+        return render_serve_snapshot(self.snapshot(**gauges))
 
     def write_to(self, writer, step: int, **gauges: Any) -> None:
         """Publish the snapshot through a clu metric writer (the
-        `trainer/metrics.py:create_writer` object), `serve/`-prefixed."""
+        `trainer/metrics.py:create_writer` object), `serve/`-prefixed.
+
+        Gauges are validated by `snapshot` (non-numeric raises there); the
+        only keys excluded here are the structural bucket arrays, which
+        have no scalar representation.
+        """
         scalars = {
             f"serve/{k}": float(v)
             for k, v in self.snapshot(**gauges).items()
-            if isinstance(v, (int, float))
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
         }
         writer.write_scalars(step, scalars)

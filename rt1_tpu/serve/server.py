@@ -15,7 +15,9 @@ Endpoints (all JSON):
 * `POST /release` {"session_id"} -> {"ok": true}
 * `GET /healthz` liveness + model/input contract (clients read the
                   expected image shape from here)
-* `GET /metrics` `ServeMetrics.snapshot()` + engine gauges
+* `GET /metrics` `ServeMetrics.snapshot()` + engine gauges as JSON; with
+                  `Accept: text/plain` (or openmetrics) the same numbers in
+                  Prometheus exposition format (rt1_tpu/obs/prometheus.py)
 
 Backpressure maps to HTTP: queue full -> 503 `busy`, draining -> 503
 `draining`. `install_signal_handlers` wires SIGTERM/SIGINT to a graceful
@@ -37,6 +39,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from rt1_tpu.obs import prometheus as obs_prometheus
+from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
 from rt1_tpu.serve.engine import PolicyEngine, SessionError
 from rt1_tpu.serve.metrics import ServeMetrics
@@ -135,7 +139,10 @@ class ServeApp:
 
     def _process(self, items):
         t0 = time.perf_counter()
-        results = self.engine.act_batch(items)
+        # obs: span on the batcher's executor thread — the serve leg of the
+        # shared host timeline (train loop + feeder workers + this).
+        with obs_trace.span("serve_batch_step", batch=len(items)):
+            results = self.engine.act_batch(items)
         self.metrics.observe_step(time.perf_counter() - t0)
         return results
 
@@ -188,15 +195,22 @@ class ServeApp:
             "compile_count": self.engine.compile_count,
         }
 
-    def metrics_snapshot(self) -> Dict[str, Any]:
-        return self.metrics.snapshot(
-            active_sessions=self.engine.active_sessions,
-            compile_count=self.engine.compile_count,
-            embed_cache_misses=self.engine.embed_calls,
+    def _engine_gauges(self) -> Dict[str, Any]:
+        return {
+            "active_sessions": self.engine.active_sessions,
+            "compile_count": self.engine.compile_count,
+            "embed_cache_misses": self.engine.embed_calls,
             # Nonzero while serving steady traffic = more live sessions
             # than slots; their context windows are thrashing to zero.
-            session_evictions=self.engine.evictions,
-        )
+            "session_evictions": self.engine.evictions,
+        }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.metrics.snapshot(**self._engine_gauges())
+
+    def metrics_prometheus(self) -> str:
+        """The same numbers in exposition text (scraper-negotiated path)."""
+        return self.metrics.prometheus_text(**self._engine_gauges())
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -230,11 +244,29 @@ class _Handler(BaseHTTPRequestHandler):
             raise RequestError("request body must be a JSON object")
         return payload
 
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):  # noqa: N802 - stdlib casing
         if self.path == "/healthz":
             self._reply(200, self.app.healthz())
         elif self.path == "/metrics":
-            self._reply(200, self.app.metrics_snapshot())
+            # Content negotiation: JSON stays the default (loadgen,
+            # existing automation); a Prometheus scraper's Accept header
+            # (`text/plain` / openmetrics) gets the exposition format.
+            if obs_prometheus.accepts_text(self.headers.get("Accept")):
+                self._reply_text(
+                    200,
+                    self.app.metrics_prometheus(),
+                    obs_prometheus.CONTENT_TYPE,
+                )
+            else:
+                self._reply(200, self.app.metrics_snapshot())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

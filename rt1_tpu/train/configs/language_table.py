@@ -140,6 +140,33 @@ def get_config():
     # must be divisible by this.
     config.mesh.stage = 1
 
+    # Observability (rt1_tpu/obs/, docs/observability.md). Defaults are
+    # resolved by obs.ObsOptions.from_config, so configs without this block
+    # (pinned proof configs) keep working.
+    config.obs = ml_collections.ConfigDict()
+    # Host-side Chrome-trace recording (train loop + feeder workers + H2D
+    # in one Perfetto timeline); dumped to obs.trace_path at exit.
+    config.obs.trace = False
+    config.obs.trace_path = ml_collections.config_dict.placeholder(str)
+    config.obs.trace_max_events = 200_000
+    # Rolling window (steps) for the stall_pct gauge / timing buckets.
+    config.obs.stall_window = 50
+    # Block on each step's output for exact device_step attribution —
+    # diagnosis mode; costs one host sync per step.
+    config.obs.sync_timing = False
+    # >= 0: serve Prometheus text on http://<host>:<port>/metrics from the
+    # train process (0 = ephemeral port, logged at startup). < 0: off.
+    config.obs.prometheus_port = -1
+    config.obs.prometheus_host = "127.0.0.1"
+    # Flight recorder: ring of the last N step records (timing buckets,
+    # feeder queue depths, loss at log steps), dumped to JSONL on an
+    # unhandled exception or SIGTERM.
+    config.obs.flight_recorder = True
+    config.obs.flight_recorder_size = 256
+    config.obs.flight_recorder_path = ml_collections.config_dict.placeholder(
+        str
+    )
+
     # Checkpoint / logging cadence.
     config.checkpoint_every_steps = 975
     config.keep_period = 9750

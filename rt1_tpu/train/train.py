@@ -391,6 +391,14 @@ def dataset_batches(config, split="train") -> Iterator:
 
 def train_and_evaluate(config, workdir: str):
     """Run the training loop; returns the final TrainState."""
+    from rt1_tpu import obs
+
+    # Observability first: the tracer must be live before dataset_batches
+    # spawns feeder workers, or their assembly spans are lost.
+    obs_opts = obs.ObsOptions.from_config(config, workdir)
+    if obs_opts.trace:
+        obs.trace.enable(obs_opts.trace_path, obs_opts.trace_max_events)
+
     writer = create_writer(workdir)
     write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
 
@@ -518,49 +526,160 @@ def train_and_evaluate(config, workdir: str):
             eval_iter = synthetic_batches(config, config.seed + 1)
 
     meter = ThroughputMeter(
-        config.per_host_batch_size * jax.process_count()
+        config.per_host_batch_size * jax.process_count(),
+        initial_step=initial_step,
     )
+    # Step wall-time attribution (wait_data/h2d/device_step/host + rolling
+    # stall_pct) — always on: a handful of perf_counter reads per step.
+    timeline = obs.StepTimeline(
+        window=obs_opts.stall_window, sync=obs_opts.sync_timing
+    )
+    # Feeder-side gauges when the packed sample-ahead feeder is the source.
+    feeder_stats = getattr(train_iter, "stats", None)
+
+    recorder = None
+    if obs_opts.flight_recorder:
+        recorder = obs.FlightRecorder(
+            obs_opts.flight_recorder_size, path=obs_opts.flight_recorder_path
+        )
+        # SIGTERM chains to SIG_DFL (process dies there) — the host trace
+        # must dump inside the handler or a terminated traced run loses it.
+        recorder.install_sigterm(
+            extra=obs.trace.dump if obs_opts.trace else None
+        )
+
+    # Opt-in Prometheus scrape target for the train process: renders the
+    # latest written scalars + rolling timing/feeder gauges on demand —
+    # scrape cost lands on the scraper's thread, not the step.
+    latest_scalars: dict = {}
+    metrics_server = None
+    if obs_opts.prometheus_port >= 0 and jax.process_index() == 0:
+        from absl import logging
+
+        def _render_prometheus():
+            scalars = dict(latest_scalars)
+            scalars.update(timeline.scalars())
+            if feeder_stats is not None:
+                scalars.update(
+                    {f"feeder/{k}": v for k, v in feeder_stats().items()}
+                )
+            return obs.prometheus.render_scalar_gauges(scalars)
+
+        metrics_server = obs.MetricsServer(
+            _render_prometheus,
+            host=obs_opts.prometheus_host,
+            port=obs_opts.prometheus_port,
+        )
+        logging.info("obs: train metrics listener at %s", metrics_server.url)
+
     # Double-buffered device feed: H2D for step N+1 overlaps compute of
     # step N (uint8 images by default — 4x fewer bytes than float32).
+    # `timeline.timed` charges time blocked on the host iterator to the
+    # wait_data bucket; the rest of next(dev_iter) is the h2d bucket.
+    import contextlib
     import itertools
 
     from rt1_tpu.data.pipeline import device_feeder
 
     dev_iter = device_feeder(
-        itertools.chain([first], train_iter), fns.batch_sharding, depth=2
+        timeline.timed(itertools.chain([first], train_iter)),
+        fns.batch_sharding,
+        depth=2,
     )
-    for step in range(initial_step, config.num_steps):
-        with step_trace("train", step):
-            state, metrics = fns.train_step(
-                state, next(dev_iter), jax.random.fold_in(rng, step)
+    def _obs_teardown():
+        # Runs on success AND on a loop exception (after the flight dump):
+        # leaking any of these poisons the next run in this process — a
+        # bound scrape port, a SIGTERM handler referencing a dead recorder,
+        # a stale process-wide tracer swallowing the next enable().
+        if metrics_server is not None:
+            metrics_server.close()
+        if recorder is not None:
+            recorder.uninstall_sigterm()
+        if obs_opts.trace:
+            from absl import logging
+
+            # disable() dumps to obs_opts.trace_path and clears the
+            # process-wide recorder, so back-to-back runs (tests, sweeps)
+            # don't bleed spans into each other's traces.
+            obs.trace.disable()
+            logging.info(
+                "obs: host trace written to %s", obs_opts.trace_path
             )
 
-        if (step + 1) % config.log_every_steps == 0:
-            scalars = scalars_from_metrics(metrics)
-            scalars.update(meter.update(step + 1))
-            writer.write_scalars(step + 1, scalars)
+    guard = (
+        recorder.dump_on_exception()
+        if recorder is not None
+        else contextlib.nullcontext()
+    )
+    cleanup = contextlib.ExitStack()
+    cleanup.callback(_obs_teardown)
+    with cleanup, guard:
+        for step in range(initial_step, config.num_steps):
+            timeline.start_step(step)
+            # The XPlane step annotation spans the batch pull + the step,
+            # as before this loop was instrumented — the device profiler's
+            # per-step view must keep including input wait/H2D.
+            with step_trace("train", step):
+                with timeline.phase("h2d", exclusive_of="wait_data"):
+                    batch = next(dev_iter)
+                with timeline.phase("device_step"):
+                    state, metrics = fns.train_step(
+                        state, batch, jax.random.fold_in(rng, step)
+                    )
+            step_record = timeline.end_step(sync_on=metrics.get("loss"))
 
-        if (
-            eval_iter is not None
-            and (step + 1) % config.eval_every_steps == 0
-        ):
-            losses = []
-            for _ in range(config.eval_batches):
-                ev = next(eval_iter)
-                ev_metrics = fns.eval_step(
-                    state,
-                    fns.shard_batch((ev["observations"], ev["actions"])),
+            log_now = (step + 1) % config.log_every_steps == 0
+            if log_now:
+                scalars = scalars_from_metrics(metrics)
+                scalars.update(meter.update(step + 1))
+                scalars.update(timeline.scalars())
+                if feeder_stats is not None:
+                    scalars.update(
+                        {
+                            f"feeder/{k}": v
+                            for k, v in feeder_stats().items()
+                        }
+                    )
+                writer.write_scalars(step + 1, scalars)
+                latest_scalars.update(scalars)
+                latest_scalars["step"] = step + 1
+
+            if recorder is not None:
+                rec = {
+                    k: v for k, v in step_record.items() if k != "step"
+                }
+                if log_now:
+                    rec["loss"] = scalars.get("loss")
+                if feeder_stats is not None:
+                    rec["feeder"] = feeder_stats()
+                recorder.record(step + 1, **rec)
+
+            if (
+                eval_iter is not None
+                and (step + 1) % config.eval_every_steps == 0
+            ):
+                losses = []
+                for _ in range(config.eval_batches):
+                    ev = next(eval_iter)
+                    ev_metrics = fns.eval_step(
+                        state,
+                        fns.shard_batch((ev["observations"], ev["actions"])),
+                    )
+                    losses.append(scalars_from_metrics(ev_metrics)["loss"])
+                writer.write_scalars(
+                    step + 1, {"eval_loss": float(np.mean(losses))}
                 )
-                losses.append(scalars_from_metrics(ev_metrics)["loss"])
-            writer.write_scalars(
-                step + 1, {"eval_loss": float(np.mean(losses))}
-            )
 
-        last = step + 1 == config.num_steps
-        if last or (step + 1) % config.checkpoint_every_steps == 0:
-            # device_get only on save steps: the full-state D2H copy would
-            # otherwise sync the host every step and kill the prefetch overlap.
-            ckpt.save(step + 1, jax.device_get(state), force=last)
+            last = step + 1 == config.num_steps
+            if last or (step + 1) % config.checkpoint_every_steps == 0:
+                # device_get only on save steps: the full-state D2H copy
+                # would otherwise sync the host every step and kill the
+                # prefetch overlap. Trace-span only, NOT a timeline bucket:
+                # this runs between steps, and folding multi-second saves
+                # into the next step's host bucket would make its buckets
+                # exceed its total.
+                with obs.trace.span("checkpoint_save", step=step + 1):
+                    ckpt.save(step + 1, jax.device_get(state), force=last)
 
     ckpt.wait_until_finished()
     writer.flush()

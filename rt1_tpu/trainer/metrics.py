@@ -63,23 +63,34 @@ def step_trace(name: str, step_num: int):
 
 
 class ThroughputMeter:
-    """steps/sec + examples/sec over a sliding window of host time."""
+    """steps/sec + examples/sec over a sliding window of host time.
 
-    def __init__(self, batch_size: int):
+    The window baseline starts at construction (anchored at
+    `initial_step`), so the FIRST `update` already reports a rate — the
+    old lazy-init swallowed the whole first logging interval. Monotonic
+    safety: a step rewind (checkpoint restore rolled the loop back)
+    rebases the window instead of reporting a negative or infinite rate;
+    the rebasing update is the only one that returns no scalars.
+    """
+
+    def __init__(self, batch_size: int, initial_step: int = 0):
         self._batch_size = batch_size
-        self._t0 = None
-        self._step0 = None
+        self._t0 = time.perf_counter()
+        self._step0 = initial_step
 
     def update(self, step: int) -> Dict[str, float]:
         now = time.perf_counter()
-        if self._t0 is None:
-            self._t0, self._step0 = now, step
-            return {}
         dt = now - self._t0
         dsteps = step - self._step0
-        self._t0, self._step0 = now, step
-        if dt <= 0 or dsteps <= 0:
+        if dsteps < 0:
+            # Non-monotonic step (restore rewind): rebase, report nothing —
+            # a window spanning the rewind has no meaningful rate.
+            self._t0, self._step0 = now, step
             return {}
+        if dt <= 0 or dsteps == 0:
+            # Same-step duplicate update: keep the window open.
+            return {}
+        self._t0, self._step0 = now, step
         n_chips = max(jax.device_count(), 1)
         return {
             "steps_per_sec": dsteps / dt,

@@ -1,14 +1,26 @@
-"""Capture an XPlane/TensorBoard profiler trace of the flagship train step.
+"""Capture an XPlane/TensorBoard profiler trace of the train step — plus
+the host-side Chrome trace (`rt1_tpu/obs/trace.py`) next to it.
 
 The reference has no profiling story beyond Lightning's progress bar
 (SURVEY.md §5 "Tracing/profiling"); Stack B wraps steps in
 `jax.profiler.StepTraceAnnotation` (`language_table/train/train.py:182`).
-This script is the deep-dive companion: it traces N real train steps on the
-attached chip with `jax.profiler.start_trace` (XPlane protos viewable in
-TensorBoard's profile plugin or Perfetto) and prints per-step wall times.
+This script is the deep-dive companion: it traces N real train steps with
+`jax.profiler.start_trace` (XPlane protos viewable in TensorBoard's
+profile plugin or Perfetto) and, in the same run, records the host
+timeline (`<logdir>/host_trace.json`) — so the device-op view and the
+host-thread view (train loop phases; with `--packed`, the sample-ahead
+feeder workers) come from the same steps.
+
+Model/state construction reuses `train.build_model` + the trainer helpers
+— the profiled step is the REAL config's step (`--model tiny` profiles
+`configs/tiny.py` at bench geometry, `flagship` the reference-parity B3),
+not a hand-rolled copy that can drift.
 
 Run (claims the TPU):
   python scripts/profile_train.py --logdir /tmp/rt1_trace --steps 5
+CPU tiny config over the PR 2 packed data path:
+  JAX_PLATFORMS=cpu python scripts/profile_train.py --model tiny --packed \
+      --logdir /tmp/rt1_trace --steps 5
 """
 
 import argparse
@@ -26,8 +38,33 @@ def main():
     p.add_argument("--steps", type=int, default=5)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--height", type=int, default=256)
-    p.add_argument("--width", type=int, default=456)
+    p.add_argument(
+        "--model", default="flagship", choices=["flagship", "tiny"],
+        help="Config under the profiler: 'flagship' = configs/language_table"
+             ".py (reference-parity B3), 'tiny' = configs/tiny.py (CPU-"
+             "runnable).")
+    p.add_argument(
+        "--height", type=int, default=0,
+        help="Image height (0 = the chosen config's data.height).")
+    p.add_argument(
+        "--width", type=int, default=0,
+        help="Image width (0 = the chosen config's data.width).")
+    p.add_argument(
+        "--packed", action="store_true",
+        help="Feed the profiled steps from the packed mmap cache via the "
+             "sample-ahead feeder (bench.py --mode e2e --packed data path) "
+             "instead of a resident synthetic batch, so the trace covers "
+             "wait/H2D and the feeder threads.")
+    p.add_argument(
+        "--data_dir", default="/tmp/rt1_bench_episodes",
+        help="--packed: episode corpus dir (synthesized on first run, "
+             "shared with bench.py).")
+    p.add_argument(
+        "--episodes", type=int, default=24, help="--packed: corpus size.")
+    p.add_argument("--src_height", type=int, default=180)
+    p.add_argument(
+        "--src_width", type=int, default=320,
+        help="--packed: synthetic corpus SOURCE frame size (see bench.py).")
     args = p.parse_args()
 
     import jax
@@ -35,9 +72,14 @@ def main():
     from rt1_tpu.compilation_cache import enable_persistent_cache
 
     enable_persistent_cache()
-    import jax.numpy as jnp
 
-    from rt1_tpu.models.rt1 import RT1Policy
+    # Host tracer first: with --packed the feeder threads start below, and
+    # their assembly spans belong in this trace.
+    from rt1_tpu.obs import trace as obs_trace
+
+    host_trace_path = os.path.join(args.logdir, "host_trace.json")
+    obs_trace.enable(host_trace_path)
+
     from rt1_tpu.parallel import MeshConfig, make_mesh
     from rt1_tpu.specs import language_table_action_space, sample_space
     from rt1_tpu.trainer import (
@@ -46,16 +88,25 @@ def main():
         make_train_step_fns,
     )
     from rt1_tpu.trainer.metrics import step_trace
+    from rt1_tpu.train.train import build_model
 
-    model = RT1Policy(
-        action_space=language_table_action_space(),
-        time_sequence_length=6,
-        dtype=jnp.bfloat16,
-    )
+    if args.model == "tiny":
+        from rt1_tpu.train.configs import tiny as config_module
+    else:
+        from rt1_tpu.train.configs import language_table as config_module
+    config = config_module.get_config()
+    mc = config.model
+    # Bench-geometry sequence length (matches the packed caches bench.py
+    # builds, so --packed reuses its corpus instead of re-packing).
+    mc.time_sequence_length = 6
+    height = args.height or config.data.height
+    width = args.width or config.data.width
+
+    model = build_model(mc)
     rng = jax.random.PRNGKey(0)
-    b, t = args.batch, 6
+    b, t = args.batch, mc.time_sequence_length
     obs = {
-        "image": jax.random.uniform(rng, (b, t, args.height, args.width, 3)),
+        "image": jax.random.uniform(rng, (b, t, height, width, 3)),
         "natural_language_embedding": jax.random.normal(
             jax.random.fold_in(rng, 1), (b, t, 512)
         ),
@@ -67,10 +118,38 @@ def main():
     state = create_train_state(model, rng, (obs, actions), make_optimizer())
     fns = make_train_step_fns(model, mesh, state)
     state = fns.shard_state(state)
-    batch = fns.shard_batch((obs, actions))
+
+    if args.packed:
+        # The exact bench feed (packed cache + sample-ahead feeder +
+        # double-buffered H2D), built by bench.py's own helper.
+        import bench as bench_module
+
+        feed_args = argparse.Namespace(
+            data_dir=args.data_dir,
+            episodes=args.episodes,
+            src_height=args.src_height,
+            src_width=args.src_width,
+            packed=True,
+            height=height,
+            width=width,
+            batch=b,
+        )
+        feed = bench_module._e2e_feed(feed_args, fns)
+
+        def next_batch():
+            with obs_trace.span("wait_batch"):
+                return next(feed)
+
+    else:
+        resident = fns.shard_batch((obs, actions))
+
+        def next_batch():
+            return resident
 
     for i in range(args.warmup):
-        state, metrics = fns.train_step(state, batch, jax.random.fold_in(rng, i))
+        state, metrics = fns.train_step(
+            state, next_batch(), jax.random.fold_in(rng, i)
+        )
         jax.block_until_ready(metrics["loss"])
 
     jax.profiler.start_trace(args.logdir)
@@ -78,18 +157,25 @@ def main():
     for i in range(args.steps):
         with step_trace("train", i):
             t0 = time.perf_counter()
-            state, metrics = fns.train_step(
-                state, batch, jax.random.fold_in(rng, 100 + i)
-            )
-            jax.block_until_ready(metrics["loss"])
+            dev_batch = next_batch()
+            with obs_trace.span("device_step", step=i):
+                state, metrics = fns.train_step(
+                    state, dev_batch, jax.random.fold_in(rng, 100 + i)
+                )
+                jax.block_until_ready(metrics["loss"])
             times.append(time.perf_counter() - t0)
     jax.profiler.stop_trace()
+    obs_trace.disable()  # dumps host_trace.json
 
     for i, dt in enumerate(times):
         print(f"step {i}: {dt * 1e3:.2f} ms")
     print(
-        f"trace written to {args.logdir} — view with TensorBoard's profile "
-        "plugin (xplane.pb) or convert to Perfetto."
+        f"device trace written to {args.logdir} — view with TensorBoard's "
+        "profile plugin (xplane.pb) or convert to Perfetto."
+    )
+    print(
+        f"host trace written to {host_trace_path} — load directly in "
+        "Perfetto / chrome://tracing (docs/observability.md)."
     )
 
 

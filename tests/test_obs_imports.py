@@ -1,0 +1,69 @@
+"""Guard: `rt1_tpu.obs` must import (and work) with no clu/tensorboard/
+tensorflow available — headless serve deployments scrape /metrics without
+dragging in the training stack. A fresh interpreter with those imports
+poisoned must still import the package and render exposition text.
+"""
+
+import os
+import subprocess
+import sys
+
+_PROBE = r"""
+import sys
+
+BLOCKED = ("clu", "tensorboard", "tensorflow")
+
+
+class Blocker:
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in BLOCKED:
+            raise ImportError(f"blocked by test_obs_imports: {name}")
+
+
+sys.meta_path.insert(0, Blocker())
+
+import rt1_tpu.obs as obs
+
+# The pieces a serve-only deployment touches must all be live.
+tracer = obs.trace.enable()
+with obs.trace.span("probe"):
+    pass
+assert len(tracer.to_dict()["traceEvents"]) >= 1
+
+tl = obs.StepTimeline(window=4)
+tl.start_step(0)
+tl.end_step()
+assert "stall_pct" in tl.scalars()
+
+rec = obs.FlightRecorder(capacity=4)
+rec.record(1, loss=0.5)
+assert len(rec) == 1
+
+from rt1_tpu.serve.metrics import ServeMetrics
+
+text = ServeMetrics().prometheus_text(active_sessions=0)
+assert "# TYPE rt1_serve_requests_total counter" in text
+assert 'le="+Inf"' in text
+
+offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
+assert not offenders, f"training deps leaked into the import: {offenders}"
+print("OK")
+"""
+
+
+def test_obs_imports_without_training_deps():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        cwd=repo,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"rt1_tpu.obs has a hard training-stack dependency:\n"
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+    )
+    assert "OK" in proc.stdout

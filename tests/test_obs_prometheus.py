@@ -1,0 +1,163 @@
+"""obs/prometheus.py: exposition text validity, serve-snapshot rendering,
+content negotiation, and the ephemeral-port scrape listener."""
+
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rt1_tpu.obs import prometheus as prom
+from rt1_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+
+
+def parse_exposition(text):
+    """Minimal format checker: returns ({family: type}, [(name, labels, value)]).
+    Raises on structural violations (samples before their # TYPE, bad
+    values) — the assertions the acceptance bar cares about."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            types[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        name_and_labels, value = line.rsplit(" ", 1)
+        if "{" in name_and_labels:
+            name, labels = name_and_labels[:-1].split("{", 1)
+            labels = dict(
+                pair.split("=", 1) for pair in labels.split(",") if pair
+            )
+            labels = {k: v.strip('"') for k, v in labels.items()}
+        else:
+            name, labels = name_and_labels, {}
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+        assert base in types, f"sample {name} has no # TYPE header"
+        float(value) if value not in ("+Inf", "-Inf") else None
+        samples.append((name, labels, value))
+    return types, samples
+
+
+def test_histogram_rendering_cumulative_le_and_inf():
+    hist = LatencyHistogram(buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.0005, 0.005, 0.05, 5.0):
+        hist.observe(v)
+
+    exp = prom.TextExposition()
+    exp.histogram(
+        "rt1_latency_seconds",
+        hist.cumulative_counts(),
+        sum_value=hist.total,
+        count=hist.count,
+        help_text="test latencies",
+    )
+    text = exp.render()
+    types, samples = parse_exposition(text)
+    assert types == {"rt1_latency_seconds": "histogram"}
+    assert "# HELP rt1_latency_seconds test latencies" in text
+
+    buckets = [
+        (labels["le"], int(v))
+        for name, labels, v in samples
+        if name == "rt1_latency_seconds_bucket"
+    ]
+    # Cumulative, ascending, +Inf == count.
+    assert buckets == [("0.001", 2), ("0.01", 3), ("0.1", 4), ("+Inf", 5)]
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts)
+    assert ("rt1_latency_seconds_count", {}, "5") in samples
+    sum_sample = [v for n, _, v in samples if n == "rt1_latency_seconds_sum"]
+    assert float(sum_sample[0]) == pytest.approx(hist.total)
+
+
+def test_duplicate_family_rejected_and_names_sanitized():
+    exp = prom.TextExposition()
+    exp.gauge("timing/wait_data_ms", 1.0)
+    with pytest.raises(ValueError):
+        exp.gauge("timing/wait_data_ms", 2.0)
+    assert prom.sanitize_name("timing/wait_data_ms") == "timing_wait_data_ms"
+    assert prom.sanitize_name("9lives") == "_9lives"
+
+
+def test_render_serve_snapshot_end_to_end():
+    metrics = ServeMetrics()
+    for _ in range(3):
+        metrics.observe_request(0.02)
+    metrics.observe_request(0.2, ok=False)
+    metrics.observe_batch(4, queued=1)
+    metrics.observe_step(0.008)
+
+    snap = metrics.snapshot(active_sessions=2, compile_count=np.int64(1))
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+
+    assert types["rt1_serve_requests_total"] == "counter"
+    assert types["rt1_serve_request_latency_seconds"] == "histogram"
+    assert types["rt1_serve_step_latency_seconds"] == "histogram"
+    assert types["rt1_serve_active_sessions"] == "gauge"
+    by_name = {n: v for n, labels, v in samples if not labels}
+    assert by_name["rt1_serve_requests_total"] == "4"
+    assert by_name["rt1_serve_errors_total"] == "1"
+    assert by_name["rt1_serve_request_latency_seconds_count"] == "4"
+    assert by_name["rt1_serve_active_sessions"] == "2"
+    assert by_name["rt1_serve_compile_count"] == "1"
+    # JSON snapshot and text expose the same bucket data.
+    inf_bucket = [
+        int(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_request_latency_seconds_bucket"
+        and labels["le"] == "+Inf"
+    ]
+    assert inf_bucket == [snap["latency_count"]]
+
+
+def test_snapshot_gauge_validation():
+    metrics = ServeMetrics()
+    # Numpy scalars coerce; snapshot stays JSON-clean.
+    snap = metrics.snapshot(active_sessions=np.float32(3.0))
+    assert snap["active_sessions"] == 3.0
+    assert isinstance(snap["active_sessions"], float)
+    # Non-numeric gauges fail loudly, naming the gauge.
+    with pytest.raises(ValueError, match="bogus"):
+        metrics.snapshot(bogus="not-a-number")
+
+
+def test_accepts_text_negotiation():
+    assert prom.accepts_text("text/plain;version=0.0.4")
+    assert prom.accepts_text("application/openmetrics-text; charset=utf-8")
+    assert not prom.accepts_text("application/json")
+    assert not prom.accepts_text("*/*")
+    assert not prom.accepts_text(None)
+    # Listed order wins: stock axios/fetch clients that ALSO accept
+    # text/plain after json must keep getting JSON.
+    assert not prom.accepts_text("application/json, text/plain, */*")
+    assert prom.accepts_text("text/plain, application/json")
+
+
+def test_metrics_server_scrape_on_ephemeral_port():
+    scalars = {"stall_pct": 12.5, "timing/wait_data_ms": 4.0, "skip": "str"}
+    server = prom.MetricsServer(
+        lambda: prom.render_scalar_gauges(scalars), port=0
+    )
+    try:
+        with urllib.request.urlopen(server.url, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == prom.CONTENT_TYPE
+            body = resp.read().decode("utf-8")
+        types, samples = parse_exposition(body)
+        by_name = {n: float(v) for n, _, v in samples}
+        assert by_name["rt1_train_stall_pct"] == 12.5
+        assert by_name["rt1_train_timing_wait_data_ms"] == 4.0
+        assert "rt1_train_skip" not in by_name  # non-numeric skipped
+        health = urllib.request.urlopen(
+            server.url.replace("/metrics", "/healthz"), timeout=5
+        )
+        assert health.read() == b"ok\n"
+    finally:
+        server.close()

@@ -1,0 +1,157 @@
+"""obs/recorder.py: ring eviction, dump-on-exception, SIGTERM chaining."""
+
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+from rt1_tpu.obs.recorder import FlightRecorder, read_dump
+
+
+def test_ring_eviction_keeps_most_recent(tmp_path):
+    rec = FlightRecorder(capacity=5, path=str(tmp_path / "fr.jsonl"))
+    for step in range(12):
+        rec.record(step, loss=float(step))
+    assert len(rec) == 5
+    path = rec.dump(reason="test")
+    doc = read_dump(path)
+    assert [r["step"] for r in doc["records"]] == [7, 8, 9, 10, 11]
+    assert doc["header"]["reason"] == "test"
+    assert doc["header"]["records"] == 5
+    assert doc["header"]["recorded_total"] == 12
+    assert doc["header"]["capacity"] == 5
+
+
+def test_records_coerce_to_json(tmp_path):
+    rec = FlightRecorder(capacity=4, path=str(tmp_path / "fr.jsonl"))
+    rec.record(
+        1,
+        loss=np.float32(0.5),
+        depths={"w0": np.int64(3)},
+        weird=object(),
+        nested=[np.float64(1.0), "ok"],
+    )
+    doc = read_dump(rec.dump())
+    r = doc["records"][0]
+    assert r["loss"] == 0.5
+    assert r["depths"] == {"w0": 3.0}
+    assert isinstance(r["weird"], str)  # repr fallback, never a crash
+    assert r["nested"] == [1.0, "ok"]
+
+
+def test_dump_on_exception_writes_then_reraises(tmp_path):
+    path = str(tmp_path / "crash" / "fr.jsonl")
+    rec = FlightRecorder(capacity=8, path=path)
+    with pytest.raises(ValueError, match="boom"):
+        with rec.dump_on_exception():
+            rec.record(1, loss=1.0)
+            rec.record(2, loss=2.0)
+            raise ValueError("boom")
+    doc = read_dump(path)
+    assert doc["header"]["reason"] == "exception:ValueError"
+    assert [r["step"] for r in doc["records"]] == [1, 2]
+
+
+def test_no_dump_on_clean_exit(tmp_path):
+    path = str(tmp_path / "fr.jsonl")
+    rec = FlightRecorder(capacity=8, path=path)
+    with rec.dump_on_exception():
+        rec.record(1)
+    assert not os.path.exists(path)
+
+
+def test_truncated_dump_still_parses(tmp_path):
+    path = str(tmp_path / "fr.jsonl")
+    rec = FlightRecorder(capacity=8, path=path)
+    for step in range(3):
+        rec.record(step)
+    rec.dump()
+    with open(path, "a") as f:
+        f.write('{"step": 99, "truncat')  # hard-kill mid-write
+    doc = read_dump(path)
+    assert [r["step"] for r in doc["records"]] == [0, 1, 2]
+
+
+def test_sigterm_dumps_and_chains_to_previous_handler(tmp_path):
+    calls = []
+    previous = signal.signal(signal.SIGTERM, lambda s, f: calls.append(s))
+    path = str(tmp_path / "fr.jsonl")
+    rec = FlightRecorder(capacity=8, path=path)
+    try:
+        assert rec.install_sigterm()
+        rec.record(5, loss=0.1)
+        signal.raise_signal(signal.SIGTERM)
+        doc = read_dump(path)
+        assert doc["header"]["reason"] == "SIGTERM"
+        assert doc["records"][0]["step"] == 5
+        assert calls == [signal.SIGTERM]  # chained, exit semantics intact
+        rec.uninstall_sigterm()
+        calls.clear()
+        signal.raise_signal(signal.SIGTERM)
+        assert calls == [signal.SIGTERM]  # back to the pre-install handler
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_sigterm_runs_extra_callback_before_chaining(tmp_path):
+    """The train loop passes the tracer's dump as `extra` — it must run
+    even when the extra itself is flaky, and before the chained handler."""
+    order = []
+    previous = signal.signal(signal.SIGTERM, lambda s, f: order.append("prev"))
+    rec = FlightRecorder(capacity=4, path=str(tmp_path / "fr.jsonl"))
+    try:
+        assert rec.install_sigterm(extra=lambda: order.append("extra"))
+        rec.record(1)
+        signal.raise_signal(signal.SIGTERM)
+        assert order == ["extra", "prev"]
+        assert read_dump(str(tmp_path / "fr.jsonl"))["header"]["reason"] == "SIGTERM"
+    finally:
+        rec.uninstall_sigterm()
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_sigterm_respects_ignored_signal(tmp_path):
+    """A wrapper that set SIG_IGN must keep its ignore-SIGTERM semantics:
+    the recorder dumps but does not re-raise (the process survives)."""
+    previous = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    path = str(tmp_path / "fr.jsonl")
+    rec = FlightRecorder(capacity=4, path=path)
+    try:
+        assert rec.install_sigterm()
+        rec.record(1)
+        signal.raise_signal(signal.SIGTERM)  # would kill us if mishandled
+        assert read_dump(path)["header"]["reason"] == "SIGTERM"
+    finally:
+        rec.uninstall_sigterm()
+        signal.signal(signal.SIGTERM, previous)
+
+
+def test_sigterm_install_refused_off_main_thread(tmp_path):
+    rec = FlightRecorder(capacity=2, path=str(tmp_path / "fr.jsonl"))
+    results = []
+    t = threading.Thread(target=lambda: results.append(rec.install_sigterm()))
+    t.start()
+    t.join()
+    assert results == [False]
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+    rec = FlightRecorder(capacity=1)
+    with pytest.raises(ValueError):
+        rec.dump()  # no path anywhere
+
+
+def test_header_is_first_line_and_jsonl(tmp_path):
+    rec = FlightRecorder(capacity=2, path=str(tmp_path / "fr.jsonl"))
+    rec.record(1)
+    path = rec.dump()
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    assert "flight_recorder" in lines[0]
+    assert "memory_stats" in lines[0]["flight_recorder"]
+    assert lines[1]["step"] == 1

@@ -1,0 +1,130 @@
+"""obs/trace.py: thread-safe Chrome-trace recording + disabled fast path."""
+
+import json
+import threading
+
+import pytest
+
+from rt1_tpu.obs import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tracer():
+    """The module-level recorder is process-wide state; isolate every test."""
+    trace._tracer = None
+    yield
+    trace._tracer = None
+
+
+def test_disabled_tracer_is_a_shared_noop():
+    assert not trace.enabled()
+    s = trace.span("anything", step=1)
+    assert s is trace._NULL_SPAN
+    with s:
+        pass
+    # Instant/counter/dump are no-ops, not errors.
+    trace.instant("marker")
+    trace.counter("depth", 3)
+    assert trace.dump() is None
+
+    # Nothing recorded once enabled afterwards: the disabled-period calls
+    # left no buffered state behind.
+    rec = trace.enable()
+    assert rec.to_dict()["traceEvents"] == []
+
+
+def test_spans_from_two_threads_serialize_to_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "trace.json")
+    trace.enable(path)
+
+    def worker():
+        for i in range(3):
+            with trace.span("worker_assemble", ticket=i):
+                pass
+
+    t = threading.Thread(target=worker, name="rt1-test-worker")
+    with trace.span("main_phase", step=0):
+        t.start()
+        t.join()
+    trace.counter("queue_depth", 2)
+    written = trace.dump()
+    assert written == path
+
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    spans = [e for e in events if e["ph"] == "X"]
+    tids = {e["tid"] for e in spans}
+    assert len(tids) >= 2, "expected spans from the main + worker threads"
+    for e in spans:
+        assert {"name", "pid", "tid", "ts", "dur"} <= set(e)
+        assert e["dur"] >= 0
+    # Thread-name metadata present for both threads, with the worker's name.
+    names = {
+        e["tid"]: e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert set(names) >= tids
+    assert "rt1-test-worker" in names.values()
+    # Counter event carries its series.
+    counters = [e for e in events if e["ph"] == "C"]
+    assert counters and counters[0]["args"] == {"value": 2}
+
+
+def test_span_args_and_instant_events(tmp_path):
+    rec = trace.enable()
+    with trace.span("phase", step=7):
+        trace.instant("inside", detail="x")
+    events = rec.to_dict()["traceEvents"]
+    by_ph = {e["ph"]: e for e in events}
+    assert by_ph["X"]["args"] == {"step": 7}
+    assert by_ph["i"]["name"] == "inside"
+    # Instant falls inside the span on the same thread's clock.
+    assert (
+        by_ph["X"]["ts"]
+        <= by_ph["i"]["ts"]
+        <= by_ph["X"]["ts"] + by_ph["X"]["dur"]
+    )
+
+
+def test_ring_bounds_memory_and_reports_drops():
+    rec = trace.enable(max_events=10)
+    for i in range(25):
+        with trace.span("s", i=i):
+            pass
+    doc = rec.to_dict()
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 10
+    # Most recent survive.
+    assert [e["args"]["i"] for e in spans] == list(range(15, 25))
+    assert doc["otherData"]["dropped_events"] == 15
+
+
+def test_enable_updates_existing_recorder(tmp_path):
+    """A stale recorder (aborted prior run) must not hijack the new run's
+    dump path or ring size — explicit enable() args win, events survive."""
+    rec = trace.enable(str(tmp_path / "old.json"), max_events=100)
+    with trace.span("kept"):
+        pass
+    same = trace.enable(str(tmp_path / "new.json"), max_events=5)
+    assert same is rec
+    assert rec.path == str(tmp_path / "new.json")
+    assert rec._events.maxlen == 5
+    assert [e["name"] for e in rec.to_dict()["traceEvents"] if e["ph"] == "X"] == ["kept"]
+    # Omitted args keep the installed configuration.
+    trace.enable()
+    assert rec.path == str(tmp_path / "new.json")
+    assert rec._events.maxlen == 5
+
+
+def test_disable_dumps_when_path_configured(tmp_path):
+    path = str(tmp_path / "out" / "trace.json")
+    trace.enable(path)
+    with trace.span("s"):
+        pass
+    trace.disable()
+    assert not trace.enabled()
+    with open(path) as f:
+        doc = json.load(f)
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])

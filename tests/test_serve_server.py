@@ -205,6 +205,30 @@ def test_metrics_endpoint_accumulates(serving_stack):
     assert 0 <= body["active_sessions"] <= 8
 
 
+def test_metrics_prometheus_content_negotiation(serving_stack):
+    """`Accept: text/plain` flips /metrics to Prometheus exposition; the
+    default stays JSON, and both report the same counters."""
+    _, _, _, url = serving_stack
+    _, body = _get(url + "/metrics")  # default: JSON, with bucket counts
+    assert body["latency_buckets"][-1][0] == "+Inf"
+    assert body["latency_buckets"][-1][1] == body["latency_count"]
+
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain;version=0.0.4"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode("utf-8")
+    assert "# TYPE rt1_serve_requests_total counter" in text
+    assert "# TYPE rt1_serve_request_latency_seconds histogram" in text
+    assert 'rt1_serve_request_latency_seconds_bucket{le="+Inf"} ' in text
+    for line in text.splitlines():
+        assert line == "" or line.startswith("#") or " " in line
+    # Same numbers through both syntaxes.
+    assert f"rt1_serve_requests_total {body['requests_total']}" in text
+
+
 def test_drain_rejects_new_work(serving_stack):
     """Runs last (name-independent: fixtures are module-scoped, and this
     mutates app state — keep it after the traffic tests)."""
