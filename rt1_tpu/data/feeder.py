@@ -33,6 +33,19 @@ import numpy as np
 
 from rt1_tpu.data.pack import PackedEpisodeCache
 from rt1_tpu.obs import trace as obs_trace
+from rt1_tpu.resilience import faults
+
+
+class FeederStalledError(RuntimeError):
+    """The consumer waited past `stall_timeout_s` with no batch and no error.
+
+    A worker that raises is already surfaced by `_raise_or_stop`; this
+    covers the worse case — a worker that deadlocks or dies *silently*
+    (native-code hang, a thread killed without unwinding) — where a plain
+    `q.get()` would block the train loop forever. The message names which
+    worker threads are still alive and the per-queue depths, so the
+    post-mortem starts with the right thread instead of a generic hang.
+    """
 
 
 class SampleAheadFeeder:
@@ -55,11 +68,18 @@ class SampleAheadFeeder:
         process_index: int = 0,
         process_count: int = 1,
         start: bool = True,
+        stall_timeout_s: Optional[float] = None,
     ):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
+        if stall_timeout_s is not None and stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s must be positive or None, got "
+                f"{stall_timeout_s}"
+            )
         self.cache = cache
         self.batch_size = batch_size
+        self.stall_timeout_s = stall_timeout_s
         self.seed = seed
         self.shuffle = shuffle
         self.num_epochs = num_epochs
@@ -185,6 +205,19 @@ class SampleAheadFeeder:
             while not self._stop.is_set():
                 if self.total_batches is not None and ticket >= self.total_batches:
                     return
+                # resilience: deterministic fault sites (one global read
+                # when no plan is installed). feeder_hang dies silently —
+                # the simulated deadlock the consumer-side stall timeout
+                # exists to diagnose; feeder_kill exercises the loud path.
+                plan = faults.active()
+                if plan is not None:
+                    if plan.should_fire("feeder_hang", index=ticket):
+                        return
+                    if plan.should_fire("feeder_kill", index=ticket):
+                        raise RuntimeError(
+                            f"injected fault [feeder_kill]: worker {k} "
+                            f"at ticket {ticket}"
+                        )
                 # obs: the span makes this worker's assembly visible on the
                 # shared host timeline; no-op (one global read) untraced.
                 t0 = time.perf_counter()
@@ -227,6 +260,7 @@ class SampleAheadFeeder:
             "queue_depth": depth,
             "queue_capacity": self.num_threads * self.depth,
             "next_ticket": self._next_ticket,
+            "workers_alive": sum(t.is_alive() for t in self._threads),
         }
         for k in range(self.num_threads):
             n = self._assembled[k]
@@ -285,6 +319,7 @@ class SampleAheadFeeder:
         if self.total_batches is not None and t >= self.total_batches:
             raise StopIteration
         q = self._queues[t % self.num_threads]
+        waited = 0.0
         while True:
             try:
                 batch = q.get(timeout=0.1)
@@ -292,8 +327,34 @@ class SampleAheadFeeder:
             except queue.Empty:
                 if self._stop.is_set():
                     self._raise_or_stop()
+                waited += 0.1
+                if (
+                    self.stall_timeout_s is not None
+                    and waited >= self.stall_timeout_s
+                ):
+                    raise self._stalled_error(t, waited)
+                if not any(th.is_alive() for th in self._threads) and q.empty():
+                    # Every worker died without raising (so no stashed
+                    # error) and nothing is queued: no batch can ever
+                    # arrive. Diagnose immediately instead of waiting out
+                    # the timeout — or forever, when none is configured.
+                    raise self._stalled_error(t, waited)
         self._next_ticket = t + 1
         return batch
+
+    def _stalled_error(self, ticket: int, waited: float) -> "FeederStalledError":
+        alive = [th.name for th in self._threads if th.is_alive()]
+        dead = [th.name for th in self._threads if not th.is_alive()]
+        depths = [qq.qsize() for qq in self._queues]
+        return FeederStalledError(
+            f"feeder stalled: waited {waited:.1f}s for ticket {ticket} "
+            f"(queue {ticket % self.num_threads}). Worker threads alive: "
+            f"{alive or 'NONE'}; dead: {dead or 'none'}; queue depths: "
+            f"{depths} (capacity {self.depth} each). A dead worker with no "
+            f"stashed error means it deadlocked or was killed without "
+            f"unwinding — check the flight-recorder dump and the host "
+            f"trace for its last feeder_assemble span."
+        )
 
     def _raise_or_stop(self) -> None:
         """Re-raise a worker's stashed error on the consumer thread, or end

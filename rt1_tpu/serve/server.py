@@ -14,10 +14,19 @@ Endpoints (all JSON):
 * `POST /reset`  {"session_id"} -> {"ok": true, "slot": i}
 * `POST /release` {"session_id"} -> {"ok": true}
 * `GET /healthz` liveness + model/input contract (clients read the
-                  expected image shape from here)
+                  expected image shape from here). Always 200 while the
+                  process serves HTTP — restart-deciders watch this.
+* `GET /readyz`  readiness: 503 before the first AOT compile completes and
+                  while draining after SIGTERM, 200 otherwise — load
+                  balancers stop routing BEFORE shutdown and never route to
+                  a replica still paying XLA latency. Liveness and
+                  readiness are deliberately separate endpoints: a draining
+                  replica is alive (do not restart it) but not ready (do
+                  not send it traffic).
 * `GET /metrics` `ServeMetrics.snapshot()` + engine gauges as JSON; with
                   `Accept: text/plain` (or openmetrics) the same numbers in
-                  Prometheus exposition format (rt1_tpu/obs/prometheus.py)
+                  Prometheus exposition format (rt1_tpu/obs/prometheus.py);
+                  includes the `draining` and `ready` gauges.
 
 Backpressure maps to HTTP: queue full -> 503 `busy`, draining -> 503
 `draining`. `install_signal_handlers` wires SIGTERM/SIGINT to a graceful
@@ -121,6 +130,9 @@ class ServeApp:
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.request_timeout_s = request_timeout_s
         self.draining = False
+        # Flipped by start() once the batcher runs and the AOT warmup
+        # compile finished — /readyz gates on it.
+        self.ready = False
         self._loop = asyncio.new_event_loop()
         self._loop_thread = threading.Thread(
             target=self._loop.run_forever, name="rt1-serve-loop", daemon=True
@@ -155,6 +167,7 @@ class ServeApp:
         ).result(timeout=10)
         if warmup:
             self.engine.warmup(self.image_shape, self.embed_dim)
+        self.ready = True
 
     def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
         """Blocking bridge used by HTTP handler threads."""
@@ -178,6 +191,7 @@ class ServeApp:
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful shutdown: reject new work, flush everything admitted."""
         self.draining = True
+        self.ready = False  # /readyz flips 503 the moment draining starts
         if self._loop_thread.is_alive():
             asyncio.run_coroutine_threadsafe(
                 self.batcher.drain(), self._loop
@@ -195,6 +209,15 @@ class ServeApp:
             "compile_count": self.engine.compile_count,
         }
 
+    def readyz(self) -> Tuple[int, Dict[str, Any]]:
+        """(http_code, payload) for the readiness probe: 503 unless the
+        first AOT compile finished AND no drain is in progress."""
+        if self.draining:
+            return 503, {"ready": False, "reason": "draining"}
+        if not self.ready:
+            return 503, {"ready": False, "reason": "warming"}
+        return 200, {"ready": True}
+
     def _engine_gauges(self) -> Dict[str, Any]:
         return {
             "active_sessions": self.engine.active_sessions,
@@ -203,6 +226,10 @@ class ServeApp:
             # Nonzero while serving steady traffic = more live sessions
             # than slots; their context windows are thrashing to zero.
             "session_evictions": self.engine.evictions,
+            # 1 while the batcher drains after SIGTERM (scrapers see the
+            # shutdown even if their LB already stopped routing /readyz).
+            "draining": int(self.draining),
+            "ready": int(self.ready),
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -255,6 +282,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - stdlib casing
         if self.path == "/healthz":
             self._reply(200, self.app.healthz())
+        elif self.path == "/readyz":
+            code, payload = self.app.readyz()
+            self._reply(code, payload)
         elif self.path == "/metrics":
             # Content negotiation: JSON stays the default (loadgen,
             # existing automation); a Prometheus scraper's Accept header

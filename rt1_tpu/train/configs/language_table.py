@@ -119,6 +119,13 @@ def get_config():
     # threads * depth batches).
     config.data.feeder_threads = 2
     config.data.feeder_depth = 2
+    # Consumer-side stall diagnosis: if the train loop waits this long for
+    # a feeder batch it raises FeederStalledError naming which workers are
+    # alive and the queue depths, instead of blocking forever on a worker
+    # that deadlocked without raising. None = wait indefinitely.
+    config.data.feeder_stall_timeout_s = ml_collections.config_dict.placeholder(
+        float
+    )
 
     # Training schedule (reference: 100 epochs x 975 steps at batch 8).
     config.per_host_batch_size = 8
@@ -166,6 +173,42 @@ def get_config():
     config.obs.flight_recorder_path = ml_collections.config_dict.placeholder(
         str
     )
+
+    # Resilience (rt1_tpu/resilience/, docs/resilience.md). Defaults are
+    # resolved by resilience.ResilienceOptions.from_config with everything
+    # OFF, so configs without this block (pinned proof configs) keep the
+    # exact pre-resilience loop; this flagship config turns the self-healing
+    # paths on.
+    config.resilience = ml_collections.ConfigDict()
+    # Step guard: device-side non-finite update skip + host-side escalation
+    # (skip -> checkpoint rollback with a fresh data seed -> abort).
+    config.resilience.guard = True
+    # > 0: also skip updates whose global grad-norm exceeds this (a
+    # train-wrecking spike that is still finite). 0 = finiteness only.
+    config.resilience.guard_grad_norm_max = 0.0
+    # > 0: flag loss > factor * EMA(healthy losses) at log steps. 0 = off
+    # (early-training loss cliffs make a universal default unsafe).
+    config.resilience.guard_loss_spike_factor = 0.0
+    config.resilience.guard_spike_ema_beta = 0.9
+    config.resilience.guard_warmup_checks = 3
+    # Consecutive bad log-step checks tolerated before rolling back.
+    config.resilience.guard_skip_budget = 3
+    # Rollbacks allowed before the run aborts (GuardAbortError).
+    config.resilience.guard_rollback_budget = 2
+    # Exponential-backoff retry on the I/O seams: checkpoint save/restore,
+    # packed-cache open, feeder construction.
+    config.resilience.io_retry = True
+    config.resilience.retry_attempts = 3
+    config.resilience.retry_backoff_s = 0.5
+    config.resilience.retry_max_backoff_s = 8.0
+    config.resilience.retry_deadline_s = 120.0
+    # SIGTERM/SIGINT -> force-save at the current step, drain the feeder,
+    # exit 0 (the preemption-resume path); a second signal escalates to the
+    # previous handler (flight-recorder dump + die).
+    config.resilience.preempt_save = True
+    # Deterministic fault schedule for chaos runs/tests (resilience/faults
+    # .py grammar, e.g. "nan_batch@7,ckpt_save@2"); RT1_FAULTS env appends.
+    config.resilience.faults = ""
 
     # Checkpoint / logging cadence.
     config.checkpoint_every_steps = 975

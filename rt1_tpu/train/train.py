@@ -3,7 +3,10 @@
 Replaces `distribute_train.py:192-247` (Lightning Trainer.fit over DDP) and
 `language_table/train/train.py:60-218` (pmap loop) with one mesh-wide jitted
 step driven by a host loop: restore-or-initialize, per-step trace annotation,
-periodic metrics/checkpoint/eval, throughput accounting.
+periodic metrics/checkpoint/eval, throughput accounting, and — via
+`config.resilience` (rt1_tpu/resilience/, docs/resilience.md) — NaN
+guardrails with checkpoint rollback, preemption-safe save-and-exit, and
+retried I/O.
 
 Run:
   python -m rt1_tpu.train.train --config rt1_tpu/train/configs/tiny.py \
@@ -241,16 +244,24 @@ def synthetic_batches(config, seed=0) -> Iterator:
         yield {"observations": obs, "actions": actions}
 
 
-def _packed_batches(config, split, paths, clip_tokenizer) -> Optional[Iterator]:
+def _packed_batches(
+    config, split, paths, clip_tokenizer, seed=None
+) -> Optional[Iterator]:
     """Packed-cache feed for `split`, or None to fall back to tf.data.
 
     The cache must exist and be fresh (same episodes, same geometry —
     build it with scripts/pack_dataset.py); anything else logs a warning
     and returns None so training proceeds on the tf.data path rather than
     training on stale pixels or dying at startup.
+
+    With `config.resilience.io_retry` the manifest/mmap open and the feeder
+    construction are retried with backoff — a transient filesystem error on
+    a network mount degrades to a warning instead of killing startup (or a
+    guard rollback's feeder rebuild mid-run).
     """
     from absl import logging
 
+    from rt1_tpu import resilience
     from rt1_tpu.data import pack as pack_lib
 
     pack_dir = config.data.get("packed_cache_dir") or pack_lib.default_pack_dir(
@@ -279,33 +290,54 @@ def _packed_batches(config, split, paths, clip_tokenizer) -> Optional[Iterator]:
         return None
     from rt1_tpu.data.feeder import SampleAheadFeeder
 
-    cache = pack_lib.PackedEpisodeCache(
+    retry_opts = resilience.ResilienceOptions.from_config(config).retry_options()
+
+    def _build(fn, *args, name, **kwargs):
+        if retry_opts is None:
+            return fn(*args, **kwargs)
+        return resilience.retry_call(
+            fn, *args, options=retry_opts, name=name, **kwargs
+        )
+
+    cache = _build(
+        pack_lib.PackedEpisodeCache,
         pack_dir,
         window=config.model.time_sequence_length,
         clip_tokenizer=clip_tokenizer,
+        name="packed_cache_open",
     )
     logging.info(
         "packed cache: feeding %s from %s (%d windows, %dx%d packed frames)",
         split, pack_dir, len(cache), cache.packed_h, cache.packed_w,
     )
-    return SampleAheadFeeder(
+    return _build(
+        SampleAheadFeeder,
         cache,
         config.per_host_batch_size,
-        seed=config.seed,
+        seed=config.seed if seed is None else seed,
         shuffle=split == "train",
         num_threads=config.data.get("feeder_threads", 2),
         depth=config.data.get("feeder_depth", 2),
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        stall_timeout_s=config.data.get("feeder_stall_timeout_s"),
+        name="feeder_construct",
     )
 
 
-def dataset_batches(config, split="train") -> Iterator:
-    """Real data: windowed episode dataset, per-host sharded."""
+def dataset_batches(config, split="train", seed=None) -> Iterator:
+    """Real data: windowed episode dataset, per-host sharded.
+
+    `seed` overrides `config.seed` for the stream's shuffle/crop draws —
+    the guard's rollback path rebuilds the iterator with a fresh seed so
+    the restored run does not re-walk the exact batch sequence that
+    produced the divergence.
+    """
     import glob
 
     from rt1_tpu.data.pipeline import WindowedEpisodeDataset
 
+    stream_seed = config.seed if seed is None else seed
     paths = sorted(
         glob.glob(os.path.join(config.data.data_dir, split, "episode_*.np*"))
     )
@@ -345,7 +377,7 @@ def dataset_batches(config, split="train") -> Iterator:
             width=config.data.width,
             batch_size=config.per_host_batch_size,
             shuffle_buffer=config.data.shuffle_buffer,
-            seed=config.seed,
+            seed=stream_seed,
             data_service_address=config.data.get("data_service_address"),
         )
         tfds = windowed_rlds_dataset(
@@ -359,7 +391,9 @@ def dataset_batches(config, split="train") -> Iterator:
         clip_tokenizer = _make_clip_tokenizer(config)
 
     if config.data.get("packed_cache", False):
-        packed_iter = _packed_batches(config, split, paths, clip_tokenizer)
+        packed_iter = _packed_batches(
+            config, split, paths, clip_tokenizer, seed=seed
+        )
         if packed_iter is not None:
             return packed_iter
         # else: fall through to the tf.data/numpy path (warned inside).
@@ -375,7 +409,7 @@ def dataset_batches(config, split="train") -> Iterator:
     if config.data.loader == "tf":
         tfds = ds.as_tf_dataset(
             batch_size=config.per_host_batch_size,
-            seed=config.seed,
+            seed=stream_seed,
             shuffle_buffer=config.data.shuffle_buffer,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
@@ -383,21 +417,49 @@ def dataset_batches(config, split="train") -> Iterator:
         return iter(tfds.as_numpy_iterator())
     return ds.numpy_batches(
         batch_size=config.per_host_batch_size,
-        seed=config.seed,
+        seed=stream_seed,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
     )
 
 
 def train_and_evaluate(config, workdir: str):
-    """Run the training loop; returns the final TrainState."""
-    from rt1_tpu import obs
+    """Run the training loop; returns the final TrainState.
+
+    Self-healing behavior (`config.resilience`, docs/resilience.md): with
+    the guard on, non-finite updates are skipped on device and persistent
+    divergence escalates to a checkpoint rollback with a fresh data-stream
+    seed (bounded by a rollback budget, then GuardAbortError); with
+    `preempt_save`, SIGTERM/SIGINT force-saves a checkpoint at the current
+    step, drains the feeder, and returns normally (exit 0) so the next
+    launch resumes exactly; with `io_retry`, checkpoint and packed-cache
+    I/O retries with backoff before giving up. All of it is off by default
+    for configs without a `resilience` block.
+    """
+    from rt1_tpu import obs, resilience
 
     # Observability first: the tracer must be live before dataset_batches
     # spawns feeder workers, or their assembly spans are lost.
     obs_opts = obs.ObsOptions.from_config(config, workdir)
     if obs_opts.trace:
         obs.trace.enable(obs_opts.trace_path, obs_opts.trace_max_events)
+
+    res_opts = resilience.ResilienceOptions.from_config(config)
+    retry_opts = res_opts.retry_options()
+    # Deterministic fault schedule (config string + RT1_FAULTS env) — the
+    # chaos-run channel; None on production runs.
+    fault_plan = resilience.faults.install_from(res_opts.faults)
+    if fault_plan is not None:
+        from absl import logging
+
+        logging.warning(
+            "resilience: fault plan armed: %s",
+            sorted(fault_plan.fired_counts()),
+        )
+    step_guard = (
+        resilience.StepGuard(res_opts.guard_options()) if res_opts.guard
+        else None
+    )
 
     writer = create_writer(workdir)
     write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
@@ -506,12 +568,15 @@ def train_and_evaluate(config, workdir: str):
             max_to_keep=config.max_to_keep or None,
             save_interval_steps=config.checkpoint_every_steps,
             keep_period=config.keep_period,
+            retry=retry_opts,
         )
     )
     state, initial_step = ckpt.restore_or_initialize(state)
 
     fns = make_train_step_fns(
-        model, mesh, state, accum_steps=config.accum_steps, loss_fn=loss_fn
+        model, mesh, state, accum_steps=config.accum_steps, loss_fn=loss_fn,
+        guard_nonfinite=res_opts.guard,
+        guard_grad_norm_max=res_opts.guard_grad_norm_max,
     )
     state = fns.shard_state(state)
 
@@ -542,6 +607,20 @@ def train_and_evaluate(config, workdir: str):
         recorder = obs.FlightRecorder(
             obs_opts.flight_recorder_size, path=obs_opts.flight_recorder_path
         )
+    coordinator = None
+    if res_opts.preempt_save:
+        # Preemption-safe shutdown: the first SIGTERM/SIGINT runs the dump
+        # callbacks (the flight record survives preemption too) and sets a
+        # flag the loop polls — the LOOP then force-saves, drains, and
+        # returns (exit 0). The recorder's own die-with-dump handler is NOT
+        # installed in this mode; a second signal restores the previous
+        # handlers and re-raises, so a wedged drain still dies honestly.
+        callbacks = []
+        if recorder is not None:
+            callbacks.append(lambda: recorder.dump(reason="preempt"))
+        coordinator = resilience.PreemptionCoordinator(callbacks=callbacks)
+        coordinator.install()
+    elif recorder is not None:
         # SIGTERM chains to SIG_DFL (process dies there) — the host trace
         # must dump inside the handler or a terminated traced run loses it.
         recorder.install_sigterm(
@@ -563,6 +642,15 @@ def train_and_evaluate(config, workdir: str):
                 scalars.update(
                     {f"feeder/{k}": v for k, v in feeder_stats().items()}
                 )
+            # rt1_train_guard_* / rt1_train_retry_* / rt1_train_preempt_*:
+            # live on every scrape, not only after a log step wrote them.
+            if step_guard is not None:
+                scalars.update(step_guard.counters())
+            scalars.update(resilience.retry.counters())
+            if coordinator is not None:
+                scalars.update(coordinator.counters())
+            if fault_plan is not None:
+                scalars.update(fault_plan.counters())
             return obs.prometheus.render_scalar_gauges(scalars)
 
         metrics_server = obs.MetricsServer(
@@ -581,8 +669,32 @@ def train_and_evaluate(config, workdir: str):
 
     from rt1_tpu.data.pipeline import device_feeder
 
+    def _host_stream(iterator, initial=()):
+        """Wrap a host batch iterator for the device feed: fault injection
+        (nan_batch site, indexed by batch ordinal within this stream) under
+        the timeline's wait_data accounting. The model-init example batch
+        is extracted BEFORE this wrapper, so a poisoned batch 0 can never
+        leak NaNs into parameter initialization."""
+        stream = itertools.chain(initial, iterator)
+        plan = resilience.faults.active()
+        if plan is not None:
+            def _with_faults(inner):
+                from absl import logging
+
+                for i, b in enumerate(inner):
+                    if plan.should_fire("nan_batch", index=i):
+                        logging.warning(
+                            "resilience: injected nan_batch at host batch "
+                            "%d", i,
+                        )
+                        b = resilience.faults.poison_batch(b)
+                    yield b
+
+            stream = _with_faults(stream)
+        return timeline.timed(stream)
+
     dev_iter = device_feeder(
-        timeline.timed(itertools.chain([first], train_iter)),
+        _host_stream(train_iter, initial=[first]),
         fns.batch_sharding,
         depth=2,
     )
@@ -593,6 +705,8 @@ def train_and_evaluate(config, workdir: str):
         # a stale process-wide tracer swallowing the next enable().
         if metrics_server is not None:
             metrics_server.close()
+        if coordinator is not None:
+            coordinator.uninstall()
         if recorder is not None:
             recorder.uninstall_sigterm()
         if obs_opts.trace:
@@ -606,15 +720,32 @@ def train_and_evaluate(config, workdir: str):
                 "obs: host trace written to %s", obs_opts.trace_path
             )
 
-    guard = (
+    crash_guard = (
         recorder.dump_on_exception()
         if recorder is not None
         else contextlib.nullcontext()
     )
+    # The host iterator is rebound on rollback; close whichever is current
+    # at exit (drains the sample-ahead feeder's worker threads).
+    live_iter = {"host": train_iter}
+
+    def _close_host_iter():
+        closer = getattr(live_iter["host"], "close", None)
+        if callable(closer):
+            closer()
+
+    guard_skips = fns.init_guard_skips() if fns.guarded else None
     cleanup = contextlib.ExitStack()
     cleanup.callback(_obs_teardown)
-    with cleanup, guard:
-        for step in range(initial_step, config.num_steps):
+    cleanup.callback(_close_host_iter)
+    with cleanup, crash_guard:
+        step = initial_step
+        while step < config.num_steps:
+            if fault_plan is not None:
+                # Self-delivered SIGTERM ("sigterm@<step>"): the chaos-run
+                # stand-in for a scheduler preemption, handled exactly like
+                # the real one (coordinator flag -> save-and-exit below).
+                resilience.faults.maybe_signal("sigterm", index=step)
             timeline.start_step(step)
             # The XPlane step annotation spans the batch pull + the step,
             # as before this loop was instrumented — the device profiler's
@@ -623,14 +754,26 @@ def train_and_evaluate(config, workdir: str):
                 with timeline.phase("h2d", exclusive_of="wait_data"):
                     batch = next(dev_iter)
                 with timeline.phase("device_step"):
-                    state, metrics = fns.train_step(
-                        state, batch, jax.random.fold_in(rng, step)
-                    )
+                    step_rng = jax.random.fold_in(rng, step)
+                    if fns.guarded:
+                        state, guard_skips, metrics = fns.train_step(
+                            state, guard_skips, batch, step_rng
+                        )
+                    else:
+                        state, metrics = fns.train_step(
+                            state, batch, step_rng
+                        )
             step_record = timeline.end_step(sync_on=metrics.get("loss"))
 
             log_now = (step + 1) % config.log_every_steps == 0
+            verdict = resilience.GuardVerdict.OK
             if log_now:
                 scalars = scalars_from_metrics(metrics)
+                # The guard judges the scalars this loop already fetched —
+                # its host-side cost at log steps is arithmetic on floats.
+                if step_guard is not None:
+                    verdict = step_guard.observe(step + 1, scalars)
+                    scalars.update(step_guard.counters())
                 scalars.update(meter.update(step + 1))
                 scalars.update(timeline.scalars())
                 if feeder_stats is not None:
@@ -640,6 +783,11 @@ def train_and_evaluate(config, workdir: str):
                             for k, v in feeder_stats().items()
                         }
                     )
+                scalars.update(resilience.retry.counters())
+                if coordinator is not None:
+                    scalars.update(coordinator.counters())
+                if fault_plan is not None:
+                    scalars.update(fault_plan.counters())
                 writer.write_scalars(step + 1, scalars)
                 latest_scalars.update(scalars)
                 latest_scalars["step"] = step + 1
@@ -650,9 +798,59 @@ def train_and_evaluate(config, workdir: str):
                 }
                 if log_now:
                     rec["loss"] = scalars.get("loss")
+                    if step_guard is not None:
+                        rec["guard"] = step_guard.counters()
+                    retry_counters = resilience.retry.counters()
+                    if retry_counters:
+                        rec["retry"] = retry_counters
                 if feeder_stats is not None:
                     rec["feeder"] = feeder_stats()
                 recorder.record(step + 1, **rec)
+
+            if verdict is resilience.GuardVerdict.ABORT:
+                raise resilience.GuardAbortError(
+                    f"guard: rollback budget "
+                    f"({res_opts.guard_rollback_budget}) exhausted and "
+                    f"training is still unhealthy at step {step + 1}: "
+                    f"{step_guard.last_reason}"
+                )
+            if verdict is resilience.GuardVerdict.ROLLBACK:
+                from absl import logging
+
+                ckpt.wait_until_finished()
+                target = ckpt.latest_step()
+                if target is None:
+                    raise resilience.GuardAbortError(
+                        f"guard: training unhealthy at step {step + 1} "
+                        f"({step_guard.last_reason}) with no checkpoint to "
+                        f"roll back to (first save at step "
+                        f"{config.checkpoint_every_steps})"
+                    )
+                logging.warning(
+                    "resilience: guard ROLLBACK at step %d (%s) — "
+                    "restoring checkpoint step %d with a fresh data seed",
+                    step + 1, step_guard.last_reason, target,
+                )
+                state = ckpt.restore(state, step=target)
+                step_guard.notify_rollback(target)
+                # Fresh stream offset: re-walking the exact batch sequence
+                # would reproduce the divergence deterministically.
+                fresh_seed = config.seed + 7919 * step_guard.rollbacks
+                _close_host_iter()
+                if config.data.data_dir:
+                    train_iter = dataset_batches(
+                        config, "train", seed=fresh_seed
+                    )
+                else:
+                    train_iter = synthetic_batches(config, fresh_seed)
+                live_iter["host"] = train_iter
+                feeder_stats = getattr(train_iter, "stats", None)
+                dev_iter = device_feeder(
+                    _host_stream(train_iter), fns.batch_sharding, depth=2
+                )
+                obs.trace.counter("guard_rollbacks", step_guard.rollbacks)
+                step = target
+                continue
 
             if (
                 eval_iter is not None
@@ -671,6 +869,7 @@ def train_and_evaluate(config, workdir: str):
                 )
 
             last = step + 1 == config.num_steps
+            saved = False
             if last or (step + 1) % config.checkpoint_every_steps == 0:
                 # device_get only on save steps: the full-state D2H copy
                 # would otherwise sync the host every step and kill the
@@ -679,7 +878,25 @@ def train_and_evaluate(config, workdir: str):
                 # into the next step's host bucket would make its buckets
                 # exceed its total.
                 with obs.trace.span("checkpoint_save", step=step + 1):
-                    ckpt.save(step + 1, jax.device_get(state), force=last)
+                    saved = ckpt.save(
+                        step + 1, jax.device_get(state), force=last
+                    )
+
+            if coordinator is not None and coordinator.triggered:
+                from absl import logging
+
+                logging.warning(
+                    "resilience: preemption signal %s — force-saving step "
+                    "%d, draining the feeder, exiting 0",
+                    coordinator.signum, step + 1,
+                )
+                if not saved:
+                    with obs.trace.span("preempt_save", step=step + 1):
+                        ckpt.save(step + 1, jax.device_get(state), force=True)
+                _close_host_iter()
+                break
+
+            step += 1
 
     ckpt.wait_until_finished()
     writer.flush()

@@ -9,14 +9,24 @@ Replaces (SURVEY.md §5 checkpoint/resume):
 Orbax is multihost-aware out of the box (each host writes its shards of a
 sharded TrainState; restore lays arrays back out on the mesh), which is the
 TPU-native replacement for clu's multihost rendezvous.
+
+Resilience (rt1_tpu/resilience/, docs/resilience.md): `CheckpointConfig.
+retry` wraps save/restore in exponential-backoff retry so a transient
+filesystem error degrades to a logged warning instead of killing the run;
+`restore_or_initialize` survives a corrupt/partial latest step by falling
+back to older retained steps (loudly); and the `ckpt_save`/`ckpt_restore`
+fault-injection sites make both paths provable in tests and chaos runs.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import orbax.checkpoint as ocp
+
+from rt1_tpu.resilience import faults
+from rt1_tpu.resilience.retry import RetryOptions, retry_call
 
 
 @dataclasses.dataclass
@@ -25,6 +35,9 @@ class CheckpointConfig:
     max_to_keep: Optional[int] = None  # None = keep everything (save_top_k=-1)
     save_interval_steps: int = 1000
     keep_period: Optional[int] = None  # also keep every Nth (keep_every_n_steps)
+    # Backoff schedule for transient I/O on save/restore; None = no retry
+    # (one attempt, errors propagate — the pre-resilience behavior).
+    retry: Optional[RetryOptions] = None
 
 
 class CheckpointManager:
@@ -42,12 +55,34 @@ class CheckpointManager:
             config.directory,
             options=options,
         )
+        # Logical-operation ordinals for fault injection: bumped once per
+        # save/restore (NOT per retry attempt), so "ckpt_save@2" means the
+        # 2nd save even when an earlier injected failure triggered retries.
+        self._save_ops = 0
+        self._restore_ops = 0
+
+    def _io(self, fn, name: str):
+        """Run an I/O closure, retried per the config (or once when off)."""
+        if self._config.retry is None:
+            return fn()
+        return retry_call(fn, options=self._config.retry, name=name)
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
-        )
-        return bool(saved)
+        self._save_ops += 1
+        op = self._save_ops
+
+        def _save():
+            # Injection precedes the real write so a "transient" spec fires
+            # once and the retry's next attempt genuinely succeeds. Indexed
+            # by the logical save ordinal, not the attempt, so a spec's
+            # extra fires (`x<K>`) land on consecutive RETRIES of the same
+            # save rather than silently consuming later saves' occurrences.
+            faults.maybe_fail("ckpt_save", index=op, what=f"save at step {step}")
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+
+        return bool(self._io(_save, "ckpt_save"))
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of `state_like`."""
@@ -57,9 +92,19 @@ class CheckpointManager:
             raise FileNotFoundError(
                 f"No checkpoint found in {self._config.directory}"
             )
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(state_like)
-        )
+
+        self._restore_ops += 1
+        op = self._restore_ops
+
+        def _restore():
+            faults.maybe_fail(
+                "ckpt_restore", index=op, what=f"restore step {step}"
+            )
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(state_like)
+            )
+
+        return self._io(_restore, "ckpt_restore")
 
     def restore_or_initialize(self, state_like: Any):
         """(state, step): restored latest, or the passed-in init at step 0.
@@ -67,14 +112,42 @@ class CheckpointManager:
         Mirrors `clu.checkpoint.restore_or_initialize` semantics
         (`language_table/train/train.py:125-127`): training resumes from
         `step + 1` after preemption.
+
+        Robust to a corrupt/partial newest step (half-written before a hard
+        kill, truncated by a full disk): a failed restore logs loudly and
+        falls back to the next-older retained step instead of wedging the
+        relaunch; only when EVERY retained step fails does the original
+        error propagate.
         """
-        latest = self._mgr.latest_step()
-        if latest is None:
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
             return state_like, 0
-        return self.restore(state_like, latest), int(latest)
+        last_exc: Optional[Exception] = None
+        for step in steps:
+            try:
+                return self.restore(state_like, step), int(step)
+            except Exception as exc:  # noqa: BLE001 - fall back per step
+                from absl import logging
+
+                last_exc = exc
+                logging.error(
+                    "checkpoint: restore of step %d in %s FAILED (%s: %s)%s",
+                    step,
+                    self._config.directory,
+                    type(exc).__name__,
+                    exc,
+                    " — falling back to the previous retained step"
+                    if step != steps[-1]
+                    else " — no older step to fall back to",
+                )
+        raise last_exc
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        """Retained step numbers (finalized only — Orbax skips tmp dirs)."""
+        return [int(s) for s in self._mgr.all_steps()]
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()
@@ -86,10 +159,26 @@ class CheckpointManager:
 def latest_step(ckpt_dir: str) -> Optional[int]:
     """Newest checkpoint step under `ckpt_dir`, or None — without building a
     CheckpointManager (cheap enough for CLI glue, watchdogs, and provenance
-    stamping; Orbax step dirs are plain integer-named directories)."""
+    stamping; Orbax step dirs are plain integer-named directories).
+
+    Defensive against in-flight/aborted writes: Orbax tmp dirs
+    (`<step>.orbax-checkpoint-tmp-<ts>`) fail the digit check, and a bare
+    EMPTY integer-named directory (mkdir happened, contents never landed)
+    is not a checkpoint either.
+    """
     import os
 
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    steps = []
+    for d in os.listdir(ckpt_dir):
+        if not d.isdigit():
+            continue  # Orbax tmp dirs and sidecar files
+        full = os.path.join(ckpt_dir, d)
+        try:
+            if not os.path.isdir(full) or not os.listdir(full):
+                continue
+        except OSError:
+            continue
+        steps.append(int(d))
     return max(steps) if steps else None

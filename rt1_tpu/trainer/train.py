@@ -33,19 +33,35 @@ Batch = Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]
 
 @dataclasses.dataclass
 class TrainStepFns:
-    """Compiled step functions + the shardings they expect."""
+    """Compiled step functions + the shardings they expect.
 
-    train_step: Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Dict[str, jnp.ndarray]]]
+    With ``guarded=True`` the train step takes and returns an extra
+    replicated device scalar — the cumulative guard-skip counter::
+
+        state, skips, metrics = fns.train_step(state, skips, batch, rng)
+
+    (initialize `skips` with :meth:`init_guard_skips`). The unguarded
+    signature stays ``(state, batch, rng) -> (state, metrics)``.
+    """
+
+    train_step: Callable[..., Tuple]
     eval_step: Callable[[TrainState, Batch], Dict[str, jnp.ndarray]]
     state_sharding: Any
     batch_sharding: NamedSharding
     mesh: Mesh
+    guarded: bool = False
 
     def shard_state(self, state: TrainState) -> TrainState:
         return jax.device_put(state, self.state_sharding)
 
     def shard_batch(self, batch: Batch) -> Batch:
         return jax.device_put(batch, self.batch_sharding)
+
+    def init_guard_skips(self) -> jax.Array:
+        """Replicated int32 zero: the cumulative skip counter's seed value."""
+        return jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
+        )
 
 
 def _loss_fn(model, params, batch_stats, batch: Batch, rng: jax.Array, train: bool):
@@ -111,6 +127,8 @@ def make_train_step_fns(
     batch_axes: Tuple[str, ...] = ("data",),
     donate: bool = True,
     loss_fn: Optional[Callable] = None,
+    guard_nonfinite: bool = False,
+    guard_grad_norm_max: float = 0.0,
 ) -> TrainStepFns:
     """Build jitted train/eval steps with explicit in/out shardings.
 
@@ -122,6 +140,18 @@ def make_train_step_fns(
     SPMD step machinery train other model families (LAVA BC MSE via
     `trainer.bc.make_bc_step_loss_fn`, reference Stack B `train.py:105-116`).
     `out` must contain "loss"; extra keys become metrics where recognized.
+
+    ``guard_nonfinite=True`` is the device half of the resilience step guard
+    (rt1_tpu/resilience/guard.py): when the step's loss or grad-norm is
+    non-finite — or the grad-norm exceeds ``guard_grad_norm_max`` (> 0) —
+    the whole state update is dropped (`jnp.where` select against the input
+    state; a skipped step leaves params, opt_state, batch_stats, and
+    `state.step` untouched). A cumulative skip counter is threaded through
+    the step as a replicated device scalar and surfaced as the
+    ``guard_skips_cum`` metric, so the host learns the exact skip count at
+    log steps without ever syncing per step. When the step is healthy the
+    select is the identity — the guarded step is numerically identical to
+    the unguarded one (pinned in tests/test_resilience_guard.py).
     """
     if param_rules is None:
         param_rules = shardlib.rt1_parameter_rules()
@@ -209,13 +239,37 @@ def make_train_step_fns(
             )
         return metrics
 
-    with mesh:
-        train_jit = jax.jit(
-            train_step,
-            in_shardings=(state_sharding, batch_sh, repl),
-            out_shardings=(state_sharding, repl),
-            donate_argnums=(0,) if donate else (),
+    def train_step_guarded(
+        state: TrainState, skips: jnp.ndarray, batch: Batch, rng: jax.Array
+    ):
+        new_state, metrics = train_step(state, batch, rng)
+        ok = jnp.isfinite(metrics["loss"]) & jnp.isfinite(metrics["grad_norm"])
+        if guard_grad_norm_max > 0:
+            ok &= metrics["grad_norm"] <= guard_grad_norm_max
+        # Dropped update = pass the INPUT state through unchanged (including
+        # `step`: an update that never happened should not count as one).
+        new_state = jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new_state, state
         )
+        skips = skips + jnp.where(ok, 0, 1).astype(jnp.int32)
+        metrics = dict(metrics, guard_skips_cum=skips)
+        return new_state, skips, metrics
+
+    with mesh:
+        if guard_nonfinite:
+            train_jit = jax.jit(
+                train_step_guarded,
+                in_shardings=(state_sharding, repl, batch_sh, repl),
+                out_shardings=(state_sharding, repl, repl),
+                donate_argnums=(0, 1) if donate else (),
+            )
+        else:
+            train_jit = jax.jit(
+                train_step,
+                in_shardings=(state_sharding, batch_sh, repl),
+                out_shardings=(state_sharding, repl),
+                donate_argnums=(0,) if donate else (),
+            )
         eval_jit = jax.jit(
             eval_step,
             in_shardings=(state_sharding, batch_sh),
@@ -228,6 +282,7 @@ def make_train_step_fns(
         state_sharding=state_sharding,
         batch_sharding=batch_sh,
         mesh=mesh,
+        guarded=guard_nonfinite,
     )
 
 

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Chaos-run driver: prove the self-healing paths on a real tiny run.
+
+Launches three short tiny-config training runs as subprocesses over a
+packed synthetic corpus (CPU, seconds each):
+
+1. **reference** — fault-free; records the final checkpoint step.
+2. **chaos phase A** — a seeded, randomized fault schedule drawn by this
+   driver: one NaN batch (guard skips the update on device), one transient
+   checkpoint-save IOError (retried with backoff), one self-delivered
+   SIGTERM mid-run (preemption coordinator force-saves and exits 0).
+3. **chaos phase B** — a plain relaunch of the same workdir; the
+   preemption-resume path (`restore_or_initialize`) carries it to
+   completion.
+
+Asserts: every run exits 0, the chaos run reaches the SAME final
+checkpoint step as the reference, the phase-A flight-recorder dump has
+reason "preempt" and shows the guard's device-skip counter, and the retry
+counter recorded at least one checkpoint-save retry. Prints a JSON summary.
+
+The fault schedule reaches the subprocesses through the ``RT1_FAULTS`` env
+var (rt1_tpu/resilience/faults.py grammar) — the same channel an operator
+uses for ad-hoc chaos drills (docs/resilience.md has the cookbook).
+
+Usable standalone::
+
+    python scripts/chaos_train.py --workdir /tmp/rt1_chaos --seed 0
+
+and as the slow-marked test `tests/test_fault_injection.py::
+test_chaos_train_end_to_end`.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/chaos_train.py`
+    sys.path.insert(0, _REPO)
+
+
+def _latest_ckpt_step(workdir):
+    """Digit-dir scan (matches trainer.checkpoints.latest_step semantics)
+    without importing jax/orbax into the driver process."""
+    ckpt_dir = os.path.join(workdir, "checkpoints")
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(d)
+        for d in os.listdir(ckpt_dir)
+        if d.isdigit() and os.listdir(os.path.join(ckpt_dir, d))
+    ]
+    return max(steps) if steps else None
+
+
+def _build_corpus(data_dir, episodes, steps_per_episode, src_h, src_w, seed):
+    import numpy as np
+
+    from rt1_tpu.data.episodes import generate_synthetic_episode, save_episode
+
+    train = os.path.join(data_dir, "train")
+    os.makedirs(train, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(episodes):
+        path = os.path.join(train, f"episode_{i}.npz")
+        if not os.path.exists(path):
+            save_episode(
+                path,
+                generate_synthetic_episode(
+                    rng, num_steps=steps_per_episode, height=src_h, width=src_w
+                ),
+            )
+        paths.append(path)
+    return paths
+
+
+def _pack_corpus(paths, data_dir, height, width, crop_factor):
+    from rt1_tpu.data.pack import default_pack_dir, pack_episodes
+
+    pack_dir = default_pack_dir(data_dir, "train")
+    pack_episodes(paths, pack_dir, height, width, crop_factor)
+    return pack_dir
+
+
+def _run_train(workdir, data_dir, num_steps, faults="", packed=True,
+               verbose=False):
+    """One training subprocess; returns (returncode, stderr_text)."""
+    cmd = [
+        sys.executable, "-m", "rt1_tpu.train.train",
+        "--config", os.path.join(_REPO, "rt1_tpu/train/configs/tiny.py"),
+        "--workdir", workdir,
+        f"--config.num_steps={num_steps}",
+        "--config.checkpoint_every_steps=2",
+        "--config.log_every_steps=1",
+        "--config.resilience.retry_backoff_s=0.05",
+    ]
+    if data_dir:
+        cmd += [
+            f"--config.data.data_dir={data_dir}",
+            "--config.data.loader=numpy",
+            f"--config.data.packed_cache={packed}",
+        ]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RT1_FAULTS"] = faults
+    proc = subprocess.run(
+        cmd, cwd=_REPO, env=env, capture_output=True, text=True,
+        timeout=600,
+    )
+    if verbose or proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:])
+    return proc.returncode, proc.stderr
+
+
+def _draw_schedule(seed, num_steps):
+    """Seeded random fault schedule with the ordering the proof needs:
+    the NaN batch and the transient save failure land BEFORE the SIGTERM,
+    so phase A exercises all three paths before it exits."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    sig_step = int(rng.integers(num_steps // 2, num_steps // 2 + 2))
+    nan_batch = int(rng.integers(1, max(2, sig_step - 2)))
+    # Saves happen every 2 steps; occurrence 1 or 2 fires at step 2 or 4,
+    # both before sig_step (>= num_steps // 2 >= 5 for the default 12).
+    save_occurrence = int(rng.integers(1, 3))
+    return (
+        f"nan_batch@{nan_batch},ckpt_save@{save_occurrence},"
+        f"sigterm@{sig_step}"
+    ), sig_step
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--workdir", default="/tmp/rt1_chaos")
+    p.add_argument("--seed", type=int, default=0,
+                   help="Seeds the corpus AND the fault schedule draw.")
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--episodes", type=int, default=6)
+    p.add_argument("--synthetic", action="store_true",
+                   help="Skip the packed corpus; train on synthetic random "
+                        "batches (faster, but does not exercise the feeder).")
+    p.add_argument("--keep", action="store_true",
+                   help="Keep the workdir (default: wiped at start).")
+    p.add_argument("--verbose", action="store_true",
+                   help="Mirror subprocess stderr.")
+    args = p.parse_args(argv)
+
+    if args.steps < 10:
+        p.error("--steps must be >= 10 (the schedule needs room for a NaN "
+                "batch and two saves before the mid-run SIGTERM)")
+    if os.path.isdir(args.workdir) and not args.keep:
+        shutil.rmtree(args.workdir)
+    os.makedirs(args.workdir, exist_ok=True)
+
+    data_dir = ""
+    if not args.synthetic:
+        data_dir = os.path.join(args.workdir, "data")
+        paths = _build_corpus(
+            data_dir, args.episodes, steps_per_episode=24,
+            src_h=48, src_w=84, seed=args.seed,
+        )
+        # tiny.py geometry: 32x56 train frames, crop_factor 0.95.
+        _pack_corpus(paths, data_dir, 32, 56, 0.95)
+
+    # 1. Fault-free reference.
+    ref_dir = os.path.join(args.workdir, "reference")
+    rc, _ = _run_train(ref_dir, data_dir, args.steps, verbose=args.verbose)
+    assert rc == 0, f"reference run failed (rc={rc})"
+    ref_step = _latest_ckpt_step(ref_dir)
+    assert ref_step == args.steps, (
+        f"reference run final checkpoint {ref_step} != {args.steps}"
+    )
+
+    # 2. Chaos phase A: NaN + transient save IOError + SIGTERM, seeded.
+    faults, sig_step = _draw_schedule(args.seed, args.steps)
+    chaos_dir = os.path.join(args.workdir, "chaos")
+    rc, stderr_a = _run_train(
+        chaos_dir, data_dir, args.steps, faults=faults, verbose=args.verbose
+    )
+    assert rc == 0, (
+        f"chaos phase A must exit 0 on SIGTERM (save-and-exit), got rc={rc}"
+    )
+    step_a = _latest_ckpt_step(chaos_dir)
+    assert step_a == sig_step + 1, (
+        f"phase A saved step {step_a}, expected sig_step+1 = {sig_step + 1}"
+    )
+
+    # Preemption dump: reason "preempt", guard + retry events recorded.
+    dump_path = os.path.join(chaos_dir, "flight_record.jsonl")
+    assert os.path.exists(dump_path), "phase A left no flight-recorder dump"
+    with open(dump_path) as f:
+        header = json.loads(f.readline())["flight_recorder"]
+        records = [json.loads(line) for line in f if line.strip()]
+    assert header["reason"] == "preempt", header
+    device_skips = max(
+        (r.get("guard", {}).get("guard/device_skips_total", 0.0)
+         for r in records),
+        default=0.0,
+    )
+    assert device_skips >= 1, (
+        f"guard device-skip counter absent from the dump: {records[-3:]}"
+    )
+    retry_events = max(
+        (r.get("retry", {}).get("retry/ckpt_save_retries_total", 0.0)
+         for r in records),
+        default=0.0,
+    )
+    assert retry_events >= 1, "ckpt_save retry counter absent from the dump"
+    assert "resilience: ckpt_save attempt" in stderr_a, (
+        "retry warning missing from phase A logs"
+    )
+
+    # 3. Chaos phase B: plain relaunch resumes to the reference's step.
+    rc, _ = _run_train(chaos_dir, data_dir, args.steps, verbose=args.verbose)
+    assert rc == 0, f"chaos phase B failed (rc={rc})"
+    final_step = _latest_ckpt_step(chaos_dir)
+    assert final_step == ref_step, (
+        f"chaos run finished at step {final_step}, reference at {ref_step}"
+    )
+
+    summary = {
+        "ok": True,
+        "faults": faults,
+        "reference_final_step": ref_step,
+        "phase_a_saved_step": step_a,
+        "final_step": final_step,
+        "guard_device_skips": device_skips,
+        "ckpt_save_retries": retry_events,
+        "preempt_dump_records": len(records),
+        "packed": not args.synthetic,
+    }
+    print(json.dumps(summary, indent=2))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

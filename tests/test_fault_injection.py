@@ -1,0 +1,204 @@
+"""Fault-injection registry + the feeder's failure/stall diagnosis paths.
+
+The registry's contract (rt1_tpu/resilience/faults.py): pure counting, no
+clocks, no randomness — the same plan fires at the same places every run.
+The feeder's contract (rt1_tpu/data/feeder.py): a worker that raises
+surfaces loudly on the consumer thread; a worker that dies *silently* is
+diagnosed by the stall timeout (FeederStalledError naming live/dead
+workers and queue depths) instead of blocking the train loop forever.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from rt1_tpu.data import episodes as ep_lib
+from rt1_tpu.data import pack as pack_lib
+from rt1_tpu.data.feeder import FeederStalledError, SampleAheadFeeder
+from rt1_tpu.resilience import faults
+
+SRC_H, SRC_W = 24, 40
+H, W = 16, 28
+WINDOW = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -------------------------------------------------------------- registry
+
+
+def test_parse_grammar_and_validation():
+    plan = faults.FaultPlan.parse("nan_batch@7, ckpt_save@2x3")
+    assert len(plan) == 2
+    assert faults.FaultPlan.parse("").fired_counts() == {}
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.FaultPlan.parse("bogus_site@1")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.FaultPlan.parse("nan_batch")
+    with pytest.raises(ValueError, match="bad fault spec"):
+        faults.FaultPlan.parse("nan_batch@x")
+
+
+def test_count_based_matching_fires_exact_occurrences():
+    plan = faults.FaultPlan.parse("ckpt_save@2x2")
+    fires = [plan.should_fire("ckpt_save") for _ in range(5)]
+    assert fires == [False, True, True, False, False]
+    assert plan.fired_counts() == {"ckpt_save@2x2": 2}
+
+
+def test_index_based_matching_respects_budget_across_replays():
+    """After a rollback the batch ordinals restart at 0 — an exhausted
+    spec must NOT re-fire at the same indices."""
+    plan = faults.FaultPlan.parse("nan_batch@3x2")
+    first_pass = [plan.should_fire("nan_batch", index=i) for i in range(6)]
+    assert first_pass == [False, False, False, True, True, False]
+    replay = [plan.should_fire("nan_batch", index=i) for i in range(6)]
+    assert replay == [False] * 6
+
+
+def test_install_from_config_and_env(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "ckpt_save@1")
+    plan = faults.install_from("nan_batch@2")
+    assert plan is faults.active() and len(plan) == 2
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.install_from("") is None
+    assert faults.active() is None
+
+
+def test_maybe_fail_raises_injected_oserror_once():
+    faults.install(faults.FaultPlan.parse("ckpt_save@1"))
+    with pytest.raises(OSError, match=r"injected fault \[ckpt_save\]"):
+        faults.maybe_fail("ckpt_save", what="save at step 2")
+    faults.maybe_fail("ckpt_save")  # occurrence 2: no-op
+
+
+def test_poison_batch_nans_floats_leaves_ints_and_source():
+    batch = {
+        "observations": {
+            "image": np.zeros((2, 3), np.uint8),
+            "natural_language_embedding": np.ones((2, 4), np.float32),
+        },
+        "actions": {
+            "terminate_episode": np.ones(2, np.int32),
+            "action": np.ones((2, 2), np.float32),
+        },
+    }
+    out = faults.poison_batch(batch)
+    assert np.isnan(out["observations"]["natural_language_embedding"]).all()
+    assert np.isnan(out["actions"]["action"]).all()
+    np.testing.assert_array_equal(
+        out["observations"]["image"], np.zeros((2, 3), np.uint8)
+    )
+    np.testing.assert_array_equal(
+        out["actions"]["terminate_episode"], np.ones(2, np.int32)
+    )
+    # The source batch is never mutated (it may be shared with a prefetch
+    # queue).
+    assert np.ones((2, 4), np.float32).sum() == batch["observations"][
+        "natural_language_embedding"
+    ].sum()
+
+
+# ---------------------------------------------------------------- feeder
+
+
+@pytest.fixture(scope="module")
+def cache(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fault_corpus")
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(4):
+        p = str(tmp / f"episode_{i}.npz")
+        ep_lib.save_episode(
+            p,
+            ep_lib.generate_synthetic_episode(
+                rng, num_steps=6, height=SRC_H, width=SRC_W
+            ),
+        )
+        paths.append(p)
+    out = str(tmp_path_factory.mktemp("fault_packed"))
+    pack_lib.pack_episodes(paths, out, H, W, 0.95)
+    return pack_lib.PackedEpisodeCache(out, window=WINDOW)
+
+
+def test_feeder_kill_fault_surfaces_on_consumer_thread(cache):
+    faults.install(faults.FaultPlan.parse("feeder_kill@1"))
+    with SampleAheadFeeder(cache, 4, seed=0, num_threads=2) as feeder:
+        with pytest.raises(RuntimeError, match="feeder worker failed") as ei:
+            for _ in range(10):
+                next(feeder)
+    assert "feeder_kill" in str(ei.value.__cause__)
+
+
+def test_feeder_hang_diagnosed_by_stall_timeout(cache):
+    """Worker 1 dies silently at ticket 1 (the simulated deadlock); the
+    consumer's stall timeout names the dead thread and the queue state
+    instead of blocking forever."""
+    faults.install(faults.FaultPlan.parse("feeder_hang@1"))
+    feeder = SampleAheadFeeder(
+        cache, 4, seed=0, num_threads=2, stall_timeout_s=0.6
+    )
+    try:
+        next(feeder)  # ticket 0 (worker 0) is fine
+        with pytest.raises(FeederStalledError) as ei:
+            for _ in range(10):
+                next(feeder)
+        msg = str(ei.value)
+        assert "rt1-feeder-1" in msg  # the dead worker is named
+        assert "queue depths" in msg
+    finally:
+        feeder.close()
+
+
+def test_feeder_all_workers_dead_diagnosed_without_timeout(cache):
+    """Even with NO stall timeout configured, a feeder whose workers all
+    died silently must not block the consumer forever."""
+    faults.install(faults.FaultPlan.parse("feeder_hang@0x2"))
+    feeder = SampleAheadFeeder(cache, 4, seed=0, num_threads=2)
+    try:
+        with pytest.raises(FeederStalledError, match="alive: NONE"):
+            for _ in range(10):
+                next(feeder)
+    finally:
+        feeder.close()
+
+
+def test_feeder_stall_timeout_validation(cache):
+    with pytest.raises(ValueError, match="stall_timeout_s"):
+        SampleAheadFeeder(cache, 4, stall_timeout_s=0.0, start=False)
+
+
+def test_feeder_stats_report_worker_liveness(cache):
+    with SampleAheadFeeder(cache, 4, seed=0, num_threads=2) as feeder:
+        next(feeder)
+        assert feeder.stats()["workers_alive"] == 2
+
+
+# ------------------------------------------------------------- chaos run
+
+
+@pytest.mark.slow
+def test_chaos_train_end_to_end(tmp_path):
+    """The acceptance run: tiny packed training with one NaN batch, one
+    transient ckpt-save IOError, and one mid-run SIGTERM + relaunch
+    reaches the same final step as a fault-free run, with guard/retry/
+    preempt events visible in the flight-recorder dump."""
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+    )
+    import chaos_train
+
+    summary = chaos_train.main(
+        ["--workdir", str(tmp_path / "chaos"), "--seed", "1"]
+    )
+    assert summary["ok"]
+    assert summary["final_step"] == summary["reference_final_step"]
+    assert summary["guard_device_skips"] >= 1
+    assert summary["ckpt_save_retries"] >= 1

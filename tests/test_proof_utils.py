@@ -66,5 +66,8 @@ def test_latest_step(tmp_path):
     assert latest_step(str(ck)) is None
     for step in (100, 2500, 900):
         (ck / str(step)).mkdir()
+        (ck / str(step) / "state").mkdir()  # finalized = has contents
     (ck / "tmp.partial").mkdir()  # non-numeric entries ignored
+    (ck / "3000.orbax-checkpoint-tmp-99").mkdir()  # in-flight Orbax write
+    (ck / "4000").mkdir()  # bare empty step dir: aborted before contents
     assert latest_step(str(ck)) == 2500

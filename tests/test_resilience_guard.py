@@ -1,0 +1,252 @@
+"""Step guard: host-side escalation ladder, device-side skip, loop e2e.
+
+The contract (rt1_tpu/resilience/guard.py + trainer/train.py guarded step +
+the train loop's rollback): a healthy run is bit-identical to the
+unguarded step; a non-finite update is dropped on device without a host
+sync; persistent badness escalates skip -> rollback (restore last good
+checkpoint, fresh data seed) -> abort, all within configured budgets, all
+counted.
+"""
+
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from rt1_tpu.resilience import faults
+from rt1_tpu.resilience.guard import (
+    GuardAbortError,
+    GuardOptions,
+    GuardVerdict,
+    StepGuard,
+)
+from rt1_tpu.resilience.retry import reset_counters
+
+from test_rt1 import make_batch, tiny_policy
+
+NAN = float("nan")
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    faults.clear()
+    reset_counters()
+    yield
+    faults.clear()
+    reset_counters()
+
+
+def _scalars(loss, grad_norm=1.0):
+    return {"loss": loss, "grad_norm": grad_norm}
+
+
+# ----------------------------------------------------------- ladder (host)
+
+
+def test_disabled_guard_always_ok():
+    g = StepGuard(GuardOptions(enabled=False))
+    assert g.observe(1, _scalars(NAN)) is GuardVerdict.OK
+
+
+def test_ladder_skip_rollback_abort_budgets():
+    g = StepGuard(
+        GuardOptions(enabled=True, skip_budget=2, rollback_budget=1)
+    )
+    assert g.observe(1, _scalars(1.0)) is GuardVerdict.OK
+    assert g.observe(2, _scalars(NAN)) is GuardVerdict.SKIP
+    assert g.observe(3, _scalars(NAN)) is GuardVerdict.SKIP
+    assert g.observe(4, _scalars(NAN)) is GuardVerdict.ROLLBACK
+    g.notify_rollback(2)
+    assert g.rollbacks == 1
+    # A healthy check resets the consecutive counter...
+    assert g.observe(3, _scalars(0.9)) is GuardVerdict.OK
+    # ...but with the rollback budget spent, the next escalation aborts.
+    assert g.observe(4, _scalars(NAN)) is GuardVerdict.SKIP
+    assert g.observe(5, _scalars(NAN)) is GuardVerdict.SKIP
+    assert g.observe(6, _scalars(NAN)) is GuardVerdict.ABORT
+    c = g.counters()
+    assert c["guard/nonfinite_total"] == 6.0
+    assert c["guard/rollbacks_total"] == 1.0
+    assert c["guard/checks_total"] == 8.0
+
+
+def test_grad_norm_threshold_and_infinite_grad():
+    g = StepGuard(
+        GuardOptions(enabled=True, grad_norm_max=10.0, skip_budget=5)
+    )
+    assert g.observe(1, _scalars(1.0, grad_norm=9.0)) is GuardVerdict.OK
+    assert g.observe(2, _scalars(1.0, grad_norm=11.0)) is GuardVerdict.SKIP
+    assert g.observe(3, _scalars(1.0, grad_norm=float("inf"))) is (
+        GuardVerdict.SKIP
+    )
+    c = g.counters()
+    assert c["guard/grad_norm_trips_total"] == 1.0
+    assert c["guard/nonfinite_total"] == 1.0
+    assert "grad_norm" in g.last_reason
+
+
+def test_loss_spike_arms_after_warmup():
+    opts = GuardOptions(
+        enabled=True, loss_spike_factor=10.0, warmup_checks=2, skip_budget=5
+    )
+    # During warmup even a huge loss passes (early-training cliffs must
+    # not trip the guard) — it just seeds the EMA.
+    g0 = StepGuard(opts)
+    assert g0.observe(1, _scalars(1000.0)) is GuardVerdict.OK
+
+    g = StepGuard(opts)
+    for step in (1, 2, 3):
+        assert g.observe(step, _scalars(5.0)) is GuardVerdict.OK
+    # Armed now: 10x the ~5.0 EMA flags.
+    assert g.observe(4, _scalars(100.0)) is GuardVerdict.SKIP
+    assert g.counters()["guard/spikes_total"] == 1.0
+    assert "spike" in g.last_reason
+    # A healthy loss afterwards clears the streak.
+    assert g.observe(5, _scalars(5.0)) is GuardVerdict.OK
+
+
+def test_device_skips_counter_rides_in_scalars():
+    g = StepGuard(GuardOptions(enabled=True))
+    g.observe(1, {"loss": 1.0, "grad_norm": 1.0, "guard_skips_cum": 3.0})
+    assert g.counters()["guard/device_skips_total"] == 3.0
+
+
+# --------------------------------------------------- guarded step (device)
+
+
+def _setup(guard, donate=True):
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+    from rt1_tpu.trainer import (
+        create_train_state,
+        make_optimizer,
+        make_train_step_fns,
+    )
+
+    model = tiny_policy()
+    rng = jax.random.PRNGKey(0)
+    obs, actions = make_batch(rng, b=8)
+    tx = make_optimizer(learning_rate=1e-3)
+    state = create_train_state(model, rng, (obs, actions), tx)
+    mesh = make_mesh(MeshConfig())
+    fns = make_train_step_fns(
+        model, mesh, state, guard_nonfinite=guard, donate=donate
+    )
+    return fns, fns.shard_state(state), (obs, actions)
+
+
+def _poisoned(batch):
+    obs, actions = batch
+    return faults.poison_batch(obs), actions
+
+
+def test_guarded_step_drops_nonfinite_update_without_sync():
+    fns, state, batch = _setup(guard=True)
+    assert fns.guarded
+    skips = fns.init_guard_skips()
+    dev_batch = fns.shard_batch(batch)
+    state, skips, metrics = fns.train_step(
+        state, skips, dev_batch, jax.random.PRNGKey(1)
+    )
+    assert int(skips) == 0 and int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+    p_before = jax.device_get(jax.tree.leaves(state.params)[0]).copy()
+    opt_before = jax.device_get(jax.tree.leaves(state.opt_state)[0])
+    bad = fns.shard_batch(_poisoned(batch))
+    state, skips, metrics = fns.train_step(
+        state, skips, bad, jax.random.PRNGKey(2)
+    )
+    # The update was dropped wholesale: params, opt_state, and the state's
+    # own step counter are untouched; only the skip counter moved.
+    assert int(skips) == 1
+    assert int(metrics["guard_skips_cum"]) == 1
+    assert int(state.step) == 1
+    assert not np.isfinite(float(metrics["loss"]))
+    np.testing.assert_array_equal(
+        p_before, jax.device_get(jax.tree.leaves(state.params)[0])
+    )
+    np.testing.assert_array_equal(
+        opt_before, jax.device_get(jax.tree.leaves(state.opt_state)[0])
+    )
+
+    # Recovery: the next clean batch trains normally.
+    state, skips, _ = fns.train_step(
+        state, skips, fns.shard_batch(batch), jax.random.PRNGKey(3)
+    )
+    assert int(skips) == 1 and int(state.step) == 2
+
+
+def test_guarded_step_is_identity_on_healthy_batches():
+    """The guard's select must not perturb a healthy update by one ULP."""
+    fns_g, state_g, batch = _setup(guard=True, donate=False)
+    fns_u, state_u, _ = _setup(guard=False, donate=False)
+    rng = jax.random.PRNGKey(7)
+    dev_g = fns_g.shard_batch(batch)
+    dev_u = fns_u.shard_batch(batch)
+    state_g, _, m_g = fns_g.train_step(
+        state_g, fns_g.init_guard_skips(), dev_g, rng
+    )
+    state_u, m_u = fns_u.train_step(state_u, dev_u, rng)
+    assert float(m_g["loss"]) == float(m_u["loss"])
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_g.params)),
+        jax.tree.leaves(jax.device_get(state_u.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------- loop e2e
+
+
+def _tiny_config(**resilience_overrides):
+    from rt1_tpu.train.configs import tiny
+
+    config = tiny.get_config()
+    config.data.height, config.data.width = 32, 56
+    config.log_every_steps = 1
+    for k, v in resilience_overrides.items():
+        config.resilience[k] = v
+    return config
+
+
+def test_train_loop_nan_rollback_completes(tmp_path, caplog):
+    """One poisoned stretch of batches: device skips, host escalates,
+    rollback restores the last checkpoint with a fresh seed, and the run
+    still reaches its full step count — the self-healing headline."""
+    from rt1_tpu.train.train import train_and_evaluate
+
+    config = _tiny_config(guard_skip_budget=1, faults="nan_batch@4x3")
+    config.num_steps = 8
+    config.checkpoint_every_steps = 2
+    with caplog.at_level(logging.WARNING):
+        state = train_and_evaluate(config, str(tmp_path / "run"))
+    assert int(state.step) == 8
+    assert os.path.isdir(tmp_path / "run" / "checkpoints" / "8")
+    messages = [r.getMessage() for r in caplog.records]
+    assert any("guard ROLLBACK" in m for m in messages)
+    assert any("injected nan_batch" in m for m in messages)
+
+
+def test_train_loop_aborts_when_rollback_budget_exhausted(tmp_path):
+    from rt1_tpu.train.train import train_and_evaluate
+
+    config = _tiny_config(
+        guard_skip_budget=0, guard_rollback_budget=0,
+        faults="nan_batch@0x50",
+    )
+    config.num_steps = 6
+    config.checkpoint_every_steps = 2
+    with pytest.raises(GuardAbortError, match="rollback budget"):
+        train_and_evaluate(config, str(tmp_path / "run"))
+
+
+def test_train_loop_aborts_clearly_with_no_checkpoint_to_roll_back(tmp_path):
+    from rt1_tpu.train.train import train_and_evaluate
+
+    config = _tiny_config(guard_skip_budget=0, faults="nan_batch@0x50")
+    config.num_steps = 6
+    config.checkpoint_every_steps = 100  # first save would be far away
+    with pytest.raises(GuardAbortError, match="no checkpoint"):
+        train_and_evaluate(config, str(tmp_path / "run"))

@@ -1,0 +1,245 @@
+"""Retry/backoff semantics + checkpoint I/O resilience.
+
+The contract (rt1_tpu/resilience/retry.py + trainer/checkpoints.py):
+transient errors back off and succeed silently-but-counted; non-transient
+errors propagate immediately; exhaustion and deadlines re-raise loudly; a
+corrupt latest checkpoint falls back to an older retained step instead of
+wedging the relaunch.
+"""
+
+import numpy as np
+import pytest
+
+from rt1_tpu.resilience import faults
+from rt1_tpu.resilience.retry import (
+    RetryOptions,
+    counters,
+    reset_counters,
+    retry_call,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    faults.clear()
+    reset_counters()
+    yield
+    faults.clear()
+    reset_counters()
+
+
+def test_backoff_schedule_success_and_cap():
+    sleeps, calls = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError("transient")
+        return "ok"
+
+    opts = RetryOptions(
+        attempts=5, backoff_s=0.1, multiplier=2.0, jitter=0.0,
+        max_backoff_s=0.25,
+    )
+    assert retry_call(flaky, options=opts, name="t", sleep=sleeps.append) == "ok"
+    # Exponential, then capped at max_backoff_s.
+    assert sleeps == [0.1, 0.2, 0.25]
+    assert counters()["retry/t_retries_total"] == 3.0
+    assert "retry/t_exhausted_total" not in counters()
+
+
+def test_jitter_shrinks_pause_deterministically():
+    sleeps = []
+
+    class FixedRng:
+        def random(self):
+            return 0.5
+
+    def always():
+        raise OSError("x")
+
+    opts = RetryOptions(attempts=2, backoff_s=1.0, jitter=0.5, deadline_s=None)
+    with pytest.raises(OSError):
+        retry_call(
+            always, options=opts, name="j", sleep=sleeps.append, rng=FixedRng()
+        )
+    # full-jitter: pause = 1.0 * (1 - 0.5 * 0.5)
+    assert sleeps == [pytest.approx(0.75)]
+
+
+def test_non_retryable_propagates_immediately():
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(
+            bug, options=RetryOptions(attempts=5), name="t",
+            sleep=lambda s: None,
+        )
+    assert len(calls) == 1
+    assert counters() == {}
+
+
+def test_exhaustion_reraises_and_counts():
+    def down():
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        retry_call(
+            down,
+            options=RetryOptions(attempts=3, backoff_s=0.0, jitter=0.0),
+            name="t",
+            sleep=lambda s: None,
+        )
+    assert counters()["retry/t_retries_total"] == 2.0
+    assert counters()["retry/t_exhausted_total"] == 1.0
+
+
+def test_deadline_caps_total_wait():
+    t = {"now": 0.0}
+
+    def down():
+        raise OSError("down")
+
+    opts = RetryOptions(
+        attempts=100, backoff_s=10.0, max_backoff_s=10.0, multiplier=1.0,
+        jitter=0.0, deadline_s=25.0,
+    )
+    with pytest.raises(OSError):
+        retry_call(
+            down, options=opts, name="d",
+            sleep=lambda s: t.__setitem__("now", t["now"] + s),
+            clock=lambda: t["now"],
+        )
+    # Two 10s retries fit under the 25s deadline; the third would not.
+    assert t["now"] == pytest.approx(20.0)
+    assert counters()["retry/d_retries_total"] == 2.0
+    assert counters()["retry/d_exhausted_total"] == 1.0
+
+
+# ------------------------------------------------------- checkpoint layer
+
+
+def _mgr(tmp_path, name, retry=None):
+    from rt1_tpu.trainer.checkpoints import CheckpointConfig, CheckpointManager
+
+    return CheckpointManager(
+        CheckpointConfig(
+            directory=str(tmp_path / name), save_interval_steps=1,
+            retry=retry,
+        )
+    )
+
+
+def test_ckpt_save_retries_injected_transient_ioerror(tmp_path):
+    faults.install(faults.FaultPlan.parse("ckpt_save@1"))
+    mgr = _mgr(
+        tmp_path, "ck",
+        retry=RetryOptions(attempts=3, backoff_s=0.01, jitter=0.0),
+    )
+    state = {"w": np.arange(4.0), "step": np.asarray(3, np.int32)}
+    assert mgr.save(1, state)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 1
+    assert counters()["retry/ckpt_save_retries_total"] == 1.0
+    # And the save genuinely landed: a round-trip restores the data.
+    restored, step = mgr.restore_or_initialize(
+        {"w": np.zeros(4), "step": np.asarray(0, np.int32)}
+    )
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], state["w"])
+
+
+def test_ckpt_fault_ordinals_count_saves_not_retry_attempts(tmp_path):
+    """Two specs on one site + retry: each logical save fails exactly once
+    (retry attempts share the save's ordinal — they must not advance the
+    schedule and consume the second spec on the first save)."""
+    faults.install(faults.FaultPlan.parse("ckpt_save@1,ckpt_save@2"))
+    mgr = _mgr(
+        tmp_path, "ck",
+        retry=RetryOptions(attempts=3, backoff_s=0.01, jitter=0.0),
+    )
+    assert mgr.save(1, {"w": np.ones(2)})
+    assert mgr.save(2, {"w": np.ones(2)})
+    mgr.wait_until_finished()
+    assert counters()["retry/ckpt_save_retries_total"] == 2.0
+    assert faults.active().fired_counts() == {
+        "ckpt_save@1": 1, "ckpt_save@2": 1,
+    }
+
+
+def test_ckpt_save_retry_exhaustion_raises(tmp_path):
+    faults.install(faults.FaultPlan.parse("ckpt_save@1x5"))
+    mgr = _mgr(
+        tmp_path, "ck",
+        retry=RetryOptions(attempts=2, backoff_s=0.01, jitter=0.0),
+    )
+    with pytest.raises(OSError, match="injected fault"):
+        mgr.save(1, {"w": np.zeros(2)})
+    assert counters()["retry/ckpt_save_exhausted_total"] == 1.0
+
+
+def test_ckpt_without_retry_config_propagates_first_error(tmp_path):
+    """retry=None keeps the pre-resilience single-attempt behavior."""
+    faults.install(faults.FaultPlan.parse("ckpt_save@1"))
+    mgr = _mgr(tmp_path, "ck")
+    with pytest.raises(OSError, match="injected fault"):
+        mgr.save(1, {"w": np.zeros(2)})
+    assert counters() == {}
+
+
+def test_ckpt_restore_retries_injected_transient_ioerror(tmp_path):
+    mgr = _mgr(
+        tmp_path, "ck",
+        retry=RetryOptions(attempts=3, backoff_s=0.01, jitter=0.0),
+    )
+    state = {"w": np.ones(3)}
+    assert mgr.save(2, state)
+    mgr.wait_until_finished()
+    faults.install(faults.FaultPlan.parse("ckpt_restore@1"))
+    restored = mgr.restore({"w": np.zeros(3)})
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    assert counters()["retry/ckpt_restore_retries_total"] == 1.0
+
+
+def _truncate_step_payload(ckpt_dir, step):
+    """Zero every tensorstore payload file under a step's item dir — the
+    on-disk shape of a mid-write hard kill / full disk."""
+    import glob
+    import os
+
+    for f in glob.glob(
+        os.path.join(str(ckpt_dir), str(step), "default", "**"),
+        recursive=True,
+    ):
+        if os.path.isfile(f):
+            open(f, "wb").close()
+
+
+def test_restore_or_initialize_falls_back_past_corrupt_latest(tmp_path):
+    """A half-written newest step must not wedge the relaunch: restore
+    falls back to the previous retained step, loudly."""
+    mgr = _mgr(tmp_path, "ck")
+    good = {"w": np.arange(6.0).reshape(2, 3)}
+    assert mgr.save(1, good)
+    assert mgr.save(2, {"w": np.full((2, 3), 9.0)})
+    mgr.wait_until_finished()
+    _truncate_step_payload(tmp_path / "ck", 2)
+
+    mgr2 = _mgr(tmp_path, "ck")
+    restored, step = mgr2.restore_or_initialize({"w": np.zeros((2, 3))})
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], good["w"])
+
+
+def test_restore_or_initialize_raises_when_all_steps_corrupt(tmp_path):
+    mgr = _mgr(tmp_path, "ck")
+    assert mgr.save(1, {"w": np.ones(2)})
+    mgr.wait_until_finished()
+    _truncate_step_payload(tmp_path / "ck", 1)
+    mgr2 = _mgr(tmp_path, "ck")
+    with pytest.raises(Exception):
+        mgr2.restore_or_initialize({"w": np.zeros(2)})

@@ -229,13 +229,47 @@ def test_metrics_prometheus_content_negotiation(serving_stack):
     assert f"rt1_serve_requests_total {body['requests_total']}" in text
 
 
+def test_readyz_is_200_while_serving(serving_stack):
+    """Readiness (load-balancer routing) is separate from liveness: a
+    started, non-draining replica is ready, and the metrics carry the
+    ready/draining gauges."""
+    _, _, _, url = serving_stack
+    status, body = _get(url + "/readyz")
+    assert status == 200 and body == {"ready": True}
+    _, metrics = _get(url + "/metrics")
+    assert metrics["ready"] == 1
+    assert metrics["draining"] == 0
+
+
+def test_readyz_warming_before_first_compile(serving_stack):
+    """An app that has not finished start()/AOT warmup reports 503
+    'warming' — the LB must not route to a replica still paying XLA
+    latency — while its liveness payload is already healthy."""
+    app, engine, _, _ = serving_stack
+    from rt1_tpu.serve import ServeApp
+
+    cold = ServeApp(engine, image_shape=(H, W, 3), embed_dim=D)
+    try:
+        code, body = cold.readyz()
+        assert code == 503 and body["reason"] == "warming"
+        assert cold.healthz()["status"] == "ok"  # alive, just not ready
+    finally:
+        cold._loop.close()
+
+
 def test_drain_rejects_new_work(serving_stack):
     """Runs last (name-independent: fixtures are module-scoped, and this
     mutates app state — keep it after the traffic tests)."""
     app, _, _, url = serving_stack
     app.drain()
     status, body = _get(url + "/healthz")
+    assert status == 200  # liveness stays 200: draining != dead
     assert body["status"] == "draining"
+    # Readiness flips 503 so load balancers stop routing BEFORE shutdown.
+    status, body = _get(url + "/readyz")
+    assert status == 503 and body["reason"] == "draining"
+    _, metrics = _get(url + "/metrics")
+    assert metrics["draining"] == 1 and metrics["ready"] == 0
     frame = np.zeros((H, W, 3), np.float32).tolist()
     status, body = _post(
         url + "/act",
