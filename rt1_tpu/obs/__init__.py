@@ -1,6 +1,6 @@
 """rt1_tpu.obs — unified observability across train, data, and serve.
 
-One subsystem, four pieces, all optional and all cheap when off:
+One subsystem, seven pieces, all optional and all cheap when off:
 
 * :mod:`rt1_tpu.obs.trace`      — host-side Chrome-trace span recorder
   (Perfetto-loadable); train loop, feeder workers, and serve batcher emit
@@ -12,6 +12,13 @@ One subsystem, four pieces, all optional and all cheap when off:
   scrape listener (`MetricsServer`).
 * :mod:`rt1_tpu.obs.recorder`   — `FlightRecorder`: ring buffer of recent
   step records, dumped to JSONL on crash/SIGTERM.
+* :mod:`rt1_tpu.obs.health`     — on-device model-health pack (per-layer
+  gradient/update norms, logit entropy, token accuracy) computed inside
+  the jitted step, fetched only at log steps.
+* :mod:`rt1_tpu.obs.goodput`    — `GoodputLedger`: run-level wall-time
+  partition (init/compile/step/stall/ckpt/rollback/preempt) + live MFU.
+* :mod:`rt1_tpu.obs.flops`      — XLA cost-analysis FLOPs + MFU math,
+  shared by `bench.py --mode mfu` and the goodput ledger.
 
 Import hygiene is part of the contract: this package (and everything it
 imports at module scope) must not require clu, tensorboard, or tensorflow
@@ -27,7 +34,8 @@ import dataclasses
 import os
 from typing import Optional
 
-from rt1_tpu.obs import prometheus, recorder, steps, trace
+from rt1_tpu.obs import flops, goodput, health, prometheus, recorder, steps, trace
+from rt1_tpu.obs.goodput import GoodputLedger
 from rt1_tpu.obs.prometheus import MetricsServer
 from rt1_tpu.obs.recorder import FlightRecorder
 from rt1_tpu.obs.steps import StepTimeline
@@ -35,10 +43,14 @@ from rt1_tpu.obs.trace import TraceRecorder
 
 __all__ = [
     "FlightRecorder",
+    "GoodputLedger",
     "MetricsServer",
     "ObsOptions",
     "StepTimeline",
     "TraceRecorder",
+    "flops",
+    "goodput",
+    "health",
     "prometheus",
     "recorder",
     "steps",
@@ -65,6 +77,19 @@ class ObsOptions:
     flight_recorder: bool = True
     flight_recorder_size: int = 256
     flight_recorder_path: Optional[str] = None  # None -> <workdir>/...jsonl
+    # Model-health pack (obs/health.py): computed inside the jitted step,
+    # fetched at log steps. Off by default so configs predating it keep a
+    # bit-identical step program.
+    model_health: bool = False
+    health_group_depth: int = 2
+    # Goodput ledger (obs/goodput.py): host-side run wall-time partition +
+    # final JSON summary. Pure host arithmetic — safe to default on.
+    goodput: bool = True
+    goodput_summary_path: Optional[str] = None  # None -> <workdir>/goodput...
+    # Live MFU gauge: estimate step FLOPs via XLA cost analysis of the
+    # *lowered* step (no extra compile). Off by default: lowering costs a
+    # second trace of the step at startup.
+    goodput_mfu: bool = False
 
     @classmethod
     def from_config(cls, config, workdir: Optional[str] = None) -> "ObsOptions":
@@ -92,5 +117,9 @@ class ObsOptions:
             if opts.flight_recorder_path is None:
                 opts.flight_recorder_path = os.path.join(
                     workdir, "flight_record.jsonl"
+                )
+            if opts.goodput_summary_path is None:
+                opts.goodput_summary_path = os.path.join(
+                    workdir, goodput.SUMMARY_BASENAME
                 )
         return opts

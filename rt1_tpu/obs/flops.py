@@ -1,0 +1,105 @@
+"""XLA cost-analysis FLOPs + MFU arithmetic, shared by bench and the ledger.
+
+Extracted from ``bench.py --mode mfu`` (which remains the lab A/B
+entrypoint) so the *same* estimator can feed the run-level goodput ledger
+(:mod:`rt1_tpu.obs.goodput`) as a live ``goodput/mfu_pct`` gauge: FLOPs per
+train step come from XLA's own cost analysis of the step program — the
+whole fwd+bwd+update graph, not a hand-derived 6·N·D guess — and MFU is
+``measured FLOP/s / peak FLOP/s``.
+
+Two analysis paths, deliberately distinct:
+
+* :func:`train_step_flops` with ``compile=False`` (default) analyzes the
+  *lowered* (pre-compile) program. No executable is built, so the train
+  loop can estimate FLOPs from ``ShapeDtypeStruct`` avals without paying a
+  second multi-minute compile or touching device memory.
+* ``compile=True`` analyzes the *compiled* executable — post-fusion, the
+  numbers ``bench.py --mode mfu`` has always published. Bench keeps this
+  path so its baselines stay comparable.
+
+Peak FLOP/s defaults to a v5e chip's bf16 197 TFLOP/s; override with the
+``RT1_TPU_PEAK_FLOPS`` env var for other generations (same knob bench has
+always honored).
+
+Import-light by contract: stdlib at module scope, jax only inside the
+functions that analyze a program (pinned by tests/test_obs_imports.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+#: Default peak FLOP/s assumed for MFU: one v5e chip's bf16 peak.
+DEFAULT_PEAK_FLOPS = 197e12
+
+PEAK_FLOPS_ENV = "RT1_TPU_PEAK_FLOPS"
+
+
+def default_peak_flops() -> float:
+    """Peak FLOP/s per chip: ``RT1_TPU_PEAK_FLOPS`` env or the v5e default."""
+    return float(os.environ.get(PEAK_FLOPS_ENV, DEFAULT_PEAK_FLOPS))
+
+
+def cost_analysis_flops(cost: Any) -> float:
+    """Pull the 'flops' entry out of a jax cost-analysis result.
+
+    Handles both shapes jax has returned over versions: a plain dict, or a
+    one-element list/tuple of dicts (one per XLA computation).
+    """
+    if cost is None:
+        return 0.0
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return float(cost.get("flops", 0.0))
+
+
+def train_step_flops(
+    jitted_fn: Any, *args: Any, compile: bool = False
+) -> Optional[float]:
+    """FLOPs of one call of `jitted_fn(*args)` per XLA cost analysis.
+
+    `args` may be concrete arrays or ``jax.ShapeDtypeStruct`` avals (the
+    train loop passes avals so no device transfer happens). Returns None
+    when the analysis is unavailable or reports zero — callers treat that
+    as "no MFU gauge", never as a real measurement.
+    """
+    try:
+        lowered = jitted_fn.lower(*args)
+        target = lowered.compile() if compile else lowered
+        flops = cost_analysis_flops(target.cost_analysis())
+    except Exception:  # noqa: BLE001 - an estimator must never kill a run
+        return None
+    return flops if flops > 0 else None
+
+
+def mfu_pct(
+    flops_per_step: float,
+    sec_per_step: float,
+    n_chips: int = 1,
+    peak_flops: Optional[float] = None,
+) -> float:
+    """Model-FLOPs-utilization in percent: achieved / peak FLOP/s."""
+    if sec_per_step <= 0 or flops_per_step <= 0:
+        return 0.0
+    peak = default_peak_flops() if peak_flops is None else float(peak_flops)
+    n = max(int(n_chips), 1)
+    return flops_per_step / sec_per_step / (peak * n) * 100.0
+
+
+def mfu_detail(
+    flops_per_step: float,
+    sec_per_step: float,
+    n_chips: int = 1,
+    peak_flops: Optional[float] = None,
+) -> Dict[str, float]:
+    """The stderr detail dict bench has always printed next to the metric."""
+    peak = default_peak_flops() if peak_flops is None else float(peak_flops)
+    return {
+        "flops_per_step": float(flops_per_step),
+        "sec_per_step": round(float(sec_per_step), 6),
+        "peak_flops_assumed": peak,
+        "mfu_pct": round(
+            mfu_pct(flops_per_step, sec_per_step, n_chips, peak), 3
+        ),
+    }

@@ -1,0 +1,223 @@
+"""On-device model-health pack: is the model *learning*, not just stepping.
+
+`obs/steps.py` answers "where does the step's wall time go"; nothing
+answered "is the optimization healthy" — per-layer-group gradient norms,
+update/param ratios, logit entropy — the signals that show divergence,
+dead layers, or a collapsing policy long before the loss curve admits it.
+
+The pack is computed *inside* the jitted train step (`trainer/train.py`,
+behind ``config.obs.model_health``) so it inherits the step's contracts:
+
+* **Zero host sync.** Every statistic is packed into ONE small replicated
+  float32 vector returned alongside the step metrics; like `loss`, it is
+  only fetched at log steps. No per-step D2H, no dispatch stall.
+* **Donation-safe.** The pack never reads the *pre-update* params — that
+  would keep every donated input buffer alive past the optimizer write
+  and break the in-place-update aliasing. It consumes the optimizer's
+  update tree instead (``TrainState.apply_gradients(return_updates=True)``;
+  ``new = old + updates`` exactly, so nothing is lost).
+* **Bit-identical when off.** The gate is a Python-level ``if`` in the
+  step builder (the same discipline as the resilience guard): with
+  ``model_health=False`` the traced program is exactly the pre-change one.
+
+Layout is static per (param tree, depth, action_dims): :func:`pack_names`
+computed on the host template and :func:`compute_pack` traced in the step
+derive the same ordering from the same pure function, so the host can
+unpack the fetched vector by position. Entries:
+
+* ``health/grad_norm/<group>``     — L2 norm of the (averaged) gradients
+  per layer group (param-tree path truncated to `depth` segments).
+* ``health/update_ratio/<group>``  — ||params_new - params_old|| /
+  (||params_new|| + eps), *post-optimizer* (LR schedule, Adam precond,
+  and clipping included). The classic healthy band is ~1e-4..1e-2.
+  The denominator is the post-update norm — within ~ratio² of the
+  pre-update one, and it saves a whole extra param-tree reduction pass
+  (the pack's cost budget is 2% of a *tiny* CPU step, bench --health).
+* ``health/param_norm_global``     — global L2 of the updated params.
+* ``health/update_norm_global``    — global L2 of the applied update.
+* ``health/logit_entropy``         — mean action-token softmax entropy in
+  nats (0 = deterministic collapse, log(vocab) = uniform; the copycat
+  collapse diagnosed in RESULTS.md shows up here first).
+* ``health/token_acc/dim<k>``      — per-action-dimension token accuracy
+  of the argmax prediction against the label, one entry per action token.
+
+Import-light by contract: jax only inside functions (pinned by
+tests/test_obs_imports.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Sequence, Tuple
+
+#: Key under which the packed vector rides in the step metrics dict. The
+#: train loop pops it before `scalars_from_metrics` (a vector has no
+#: meaningful scalar mean) and unpacks it against `TrainStepFns.health_names`.
+PACK_KEY = "health_pack"
+
+#: Guard against division by a zero param norm (fresh zeros-init leaves).
+_EPS = 1e-12
+
+#: Default group depth: 2 path segments gives per-layer granularity on the
+#: RT-1 tree (``transformer/layer_3``) without per-kernel explosion.
+DEFAULT_GROUP_DEPTH = 2
+
+
+def _path_str(path: Sequence[Any]) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return "/".join(parts)
+
+
+def param_groups(params: Any, depth: int = DEFAULT_GROUP_DEPTH) -> List[str]:
+    """Sorted group names: param-tree paths truncated to `depth` segments.
+
+    Pure function of the tree *structure* — callable on the host template
+    state and inside a trace with identical results, which is what keeps
+    the packed layout and the host-side names in lockstep.
+    """
+    import jax
+
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return sorted({_path_str(path[:depth]) for path, _ in leaves})
+
+
+def pack_names(
+    params: Any,
+    depth: int = DEFAULT_GROUP_DEPTH,
+    action_dims: int = 0,
+    prefix: str = "health/",
+) -> Tuple[str, ...]:
+    """The pack's entry names, in pack order (host-side contract)."""
+    groups = param_groups(params, depth)
+    names = [f"{prefix}grad_norm/{g}" for g in groups]
+    names += [f"{prefix}update_ratio/{g}" for g in groups]
+    names += [f"{prefix}param_norm_global", f"{prefix}update_norm_global"]
+    if action_dims > 0:
+        names.append(f"{prefix}logit_entropy")
+        names += [f"{prefix}token_acc/dim{k}" for k in range(action_dims)]
+    return tuple(names)
+
+
+def _grouped_sumsq(tree: Any, depth: int) -> Dict[str, Any]:
+    """{group: sum of squares} over the tree's leaves (traced).
+
+    Per-leaf reductions, deliberately in the same form as
+    `trainer.train.optax_global_norm` — when both run over the SAME tree
+    (the gradients) XLA's CSE merges the subcomputations and this pass is
+    free next to the ``grad_norm`` metric the step already emits.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        group = _path_str(path[:depth])
+        sq = jnp.sum(jnp.square(jnp.asarray(leaf, jnp.float32)))
+        out[group] = out.get(group, 0.0) + sq
+    return out
+
+
+def _grouped_sumsq_concat(tree: Any, depth: int) -> Dict[str, Any]:
+    """Like :func:`_grouped_sumsq`, via one concat + one vdot per group.
+
+    ~8 ops per tree instead of ~|leaves|: on XLA:CPU each un-fused
+    reduction pays a dispatch, and the pack's budget is 2% of a *tiny*
+    step (bench.py --health). The transient per-group flat copies are
+    noise next to activations at RT-1 scale.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    grouped: Dict[str, list] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        grouped.setdefault(_path_str(path[:depth]), []).append(
+            jnp.ravel(jnp.asarray(leaf, jnp.float32))
+        )
+    out: Dict[str, Any] = {}
+    for group, flats in grouped.items():
+        v = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        out[group] = jnp.vdot(v, v)
+    return out
+
+
+def compute_pack(
+    updates: Any,
+    new_params: Any,
+    grads: Any,
+    out: Mapping[str, Any],
+    depth: int = DEFAULT_GROUP_DEPTH,
+    action_dims: int = 0,
+):
+    """Build the packed health vector inside the traced train step.
+
+    `updates` is the optimizer's applied update tree (``new = old +
+    updates``) — taking it instead of (old, new) params matters beyond
+    convenience: a pack that reads the *pre-update* params would force
+    XLA to keep every donated input param buffer alive past the optimizer
+    write, breaking the in-place-update aliasing the donated-state
+    contract exists for.
+
+    `out` is the loss closure's aux dict; action-logit statistics are read
+    from it only when ``action_dims > 0`` (the builder decides that
+    statically — RT-1 loss with accum_steps == 1). Returns a float32
+    vector whose entries line up with :func:`pack_names` called with the
+    same (tree, depth, action_dims).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    groups = param_groups(new_params, depth)
+    # Grads per-leaf (CSE-merges with the step's grad_norm metric, ~free);
+    # updates/new-params via concat+vdot (few ops — no metric to CSE with).
+    grad_sq = _grouped_sumsq(grads, depth)
+    upd_sq = _grouped_sumsq_concat(updates, depth)
+    new_sq = _grouped_sumsq_concat(new_params, depth)
+
+    parts = [
+        jnp.stack([jnp.sqrt(grad_sq[g]) for g in groups]),
+        jnp.stack(
+            [
+                jnp.sqrt(upd_sq[g]) / (jnp.sqrt(new_sq[g]) + _EPS)
+                for g in groups
+            ]
+        ),
+        jnp.sqrt(sum(new_sq[g] for g in groups))[None],
+        jnp.sqrt(sum(upd_sq[g] for g in groups))[None],
+    ]
+
+    if action_dims > 0:
+        logits = jnp.asarray(out["action_logits"], jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        parts.append(-jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=-1))[None])
+        correct = (out["action_predictions"] == out["action_labels"]).astype(
+            jnp.float32
+        )  # (b, t, A)
+        per_dim = jnp.mean(correct, axis=(0, 1))  # (A,)
+        if per_dim.shape[0] != action_dims:
+            raise ValueError(
+                f"action_dims={action_dims} but the step produced "
+                f"{per_dim.shape[0]} action token dims"
+            )
+        parts.append(per_dim)
+    return jnp.concatenate(parts).astype(jnp.float32)
+
+
+def unpack(names: Sequence[str], vector: Any) -> Dict[str, float]:
+    """Fetched pack vector -> {name: float} for the scalar stream.
+
+    The names come out as e.g. ``health/grad_norm/transformer/layer_0`` —
+    the clu writer takes them as-is, and the train Prometheus listener's
+    sanitizer renders them as ``rt1_train_health_grad_norm_...`` gauges.
+    """
+    import numpy as np
+
+    values = np.asarray(vector, dtype=np.float64).reshape(-1)
+    if values.shape[0] != len(names):
+        raise ValueError(
+            f"health pack length {values.shape[0]} != {len(names)} names — "
+            f"the step builder and the host disagree on the layout"
+        )
+    return {name: float(v) for name, v in zip(names, values)}

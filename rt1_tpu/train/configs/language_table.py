@@ -173,6 +173,26 @@ def get_config():
     config.obs.flight_recorder_path = ml_collections.config_dict.placeholder(
         str
     )
+    # Model-health pack (obs/health.py): per-layer-group gradient norms,
+    # post-optimizer update/param ratios, logit entropy, and per-dimension
+    # token accuracy, computed inside the jitted step and fetched at log
+    # steps (health/* scalars, rt1_train_health_* gauges). Measured
+    # overhead on the packed tiny e2e bench is within the <=2% budget
+    # (bench.py --health); off = bit-identical pre-health step program.
+    config.obs.model_health = True
+    # Param-tree path depth for health layer groups (2 = per decoder layer).
+    config.obs.health_group_depth = 2
+    # Run-level goodput ledger (obs/goodput.py): wall-time partition into
+    # init/compile/step/data_stall/ckpt/rollback/preempt buckets, goodput/*
+    # scalars + rt1_train_goodput_* gauges + <workdir>/goodput_summary.json
+    # (merged into a post-mortem by scripts/run_report.py).
+    config.obs.goodput = True
+    config.obs.goodput_summary_path = ml_collections.config_dict.placeholder(
+        str
+    )
+    # Live MFU gauge from XLA cost analysis of the lowered step (no second
+    # compile; one extra trace of the step at startup).
+    config.obs.goodput_mfu = True
 
     # Resilience (rt1_tpu/resilience/, docs/resilience.md). Defaults are
     # resolved by resilience.ResilienceOptions.from_config with everything

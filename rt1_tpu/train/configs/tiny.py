@@ -21,6 +21,13 @@ def get_config():
 
     config.data.height = 32
     config.data.width = 56
+    # The flagship ships model_health + the MFU estimator on; the smoke
+    # config keeps them off so its many tier-1 loop invocations don't each
+    # pay the pack's extra compile + the lowering retrace. Tests and the
+    # 25-step acceptance run enable them explicitly
+    # (--config.obs.model_health=True --config.obs.goodput_mfu=True).
+    config.obs.model_health = False
+    config.obs.goodput_mfu = False
     # Divisible by the data axis on both 1-device and 8-device (virtual CPU
     # mesh) runs.
     config.per_host_batch_size = 8

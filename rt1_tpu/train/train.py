@@ -444,6 +444,14 @@ def train_and_evaluate(config, workdir: str):
     if obs_opts.trace:
         obs.trace.enable(obs_opts.trace_path, obs_opts.trace_max_events)
 
+    # Run-level goodput ledger (obs/goodput.py): everything from here to
+    # the first loop step accrues to its "init" bucket (checkpoint restore
+    # time is carved out into "ckpt_restore" via the manager's on_io hook).
+    ledger = None
+    if obs_opts.goodput:
+        ledger = obs.GoodputLedger()
+        ledger.open_phase("init")
+
     res_opts = resilience.ResilienceOptions.from_config(config)
     retry_opts = res_opts.retry_options()
     # Deterministic fault schedule (config string + RT1_FAULTS env) — the
@@ -569,6 +577,7 @@ def train_and_evaluate(config, workdir: str):
             save_interval_steps=config.checkpoint_every_steps,
             keep_period=config.keep_period,
             retry=retry_opts,
+            on_io=ledger.note_io if ledger is not None else None,
         )
     )
     state, initial_step = ckpt.restore_or_initialize(state)
@@ -577,8 +586,32 @@ def train_and_evaluate(config, workdir: str):
         model, mesh, state, accum_steps=config.accum_steps, loss_fn=loss_fn,
         guard_nonfinite=res_opts.guard,
         guard_grad_norm_max=res_opts.guard_grad_norm_max,
+        model_health=obs_opts.model_health,
+        health_group_depth=obs_opts.health_group_depth,
     )
     state = fns.shard_state(state)
+
+    if ledger is not None and obs_opts.goodput_mfu:
+        # Arm the live MFU gauge: FLOPs per step from XLA cost analysis of
+        # the LOWERED step program — avals only, so no second compile and
+        # no extra device transfer; a failed estimate just disarms the
+        # gauge (obs/flops.py returns None).
+        with obs.trace.span("goodput_flops_estimate"):
+            batch_tpl = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (first["observations"], first["actions"]),
+            )
+            rng_tpl = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            if fns.guarded:
+                skips_tpl = jax.ShapeDtypeStruct((), jnp.int32)
+                flops = obs.flops.train_step_flops(
+                    fns.train_step, state, skips_tpl, batch_tpl, rng_tpl
+                )
+            else:
+                flops = obs.flops.train_step_flops(
+                    fns.train_step, state, batch_tpl, rng_tpl
+                )
+            ledger.set_flops_per_step(flops, n_chips=jax.device_count())
 
     eval_iter = None
     if config.eval_every_steps:
@@ -651,6 +684,11 @@ def train_and_evaluate(config, workdir: str):
                 scalars.update(coordinator.counters())
             if fault_plan is not None:
                 scalars.update(fault_plan.counters())
+            # rt1_train_goodput_*: live run-level wall-time partition +
+            # MFU on every scrape (rt1_train_health_* ride in via
+            # latest_scalars from the last log step).
+            if ledger is not None:
+                scalars.update(ledger.scalars())
             return obs.prometheus.render_scalar_gauges(scalars)
 
         metrics_server = obs.MetricsServer(
@@ -734,10 +772,37 @@ def train_and_evaluate(config, workdir: str):
         if callable(closer):
             closer()
 
+    def _write_goodput():
+        # Success, crash, and preempt paths all leave a summary on disk —
+        # run_report's post-mortem needs it most when the run died.
+        if ledger is None or not obs_opts.goodput_summary_path:
+            return
+        if jax.process_index() != 0:
+            return
+        from absl import logging
+
+        try:
+            path = ledger.write_summary(obs_opts.goodput_summary_path)
+            s = ledger.summary()
+            logging.info(
+                "obs: goodput summary at %s (goodput %.1f%%, badput %.1f%%"
+                "%s)",
+                path, s["goodput_pct"], s["badput_pct"],
+                ", mfu %.2f%%" % s["mfu_pct"] if "mfu_pct" in s else "",
+            )
+        except Exception:  # noqa: BLE001 - accounting must not mask exits
+            pass
+
     guard_skips = fns.init_guard_skips() if fns.guarded else None
+    # Steps at or before this mark are post-rollback re-runs — badput the
+    # ledger books as rollback_replay, not productive step time.
+    replay_until = initial_step
     cleanup = contextlib.ExitStack()
     cleanup.callback(_obs_teardown)
     cleanup.callback(_close_host_iter)
+    cleanup.callback(_write_goodput)
+    if ledger is not None:
+        ledger.close_phase()  # init ends where the step loop begins
     with cleanup, crash_guard:
         step = initial_step
         while step < config.num_steps:
@@ -764,11 +829,27 @@ def train_and_evaluate(config, workdir: str):
                             state, batch, step_rng
                         )
             step_record = timeline.end_step(sync_on=metrics.get("loss"))
+            if ledger is not None:
+                ledger.note_step(step_record, replay=step < replay_until)
 
             log_now = (step + 1) % config.log_every_steps == 0
             verdict = resilience.GuardVerdict.OK
+            health_scalars = None
             if log_now:
+                # The health pack is a vector — pop it before the per-key
+                # scalar fetch (a mean over the pack is meaningless) and
+                # unpack it against the step builder's name layout.
+                health_vec = (
+                    metrics.pop(obs.health.PACK_KEY, None)
+                    if fns.health_names
+                    else None
+                )
                 scalars = scalars_from_metrics(metrics)
+                if health_vec is not None:
+                    health_scalars = obs.health.unpack(
+                        fns.health_names, health_vec
+                    )
+                    scalars.update(health_scalars)
                 # The guard judges the scalars this loop already fetched —
                 # its host-side cost at log steps is arithmetic on floats.
                 if step_guard is not None:
@@ -776,6 +857,8 @@ def train_and_evaluate(config, workdir: str):
                     scalars.update(step_guard.counters())
                 scalars.update(meter.update(step + 1))
                 scalars.update(timeline.scalars())
+                if ledger is not None:
+                    scalars.update(ledger.scalars())
                 if feeder_stats is not None:
                     scalars.update(
                         {
@@ -798,6 +881,8 @@ def train_and_evaluate(config, workdir: str):
                 }
                 if log_now:
                     rec["loss"] = scalars.get("loss")
+                    if health_scalars is not None:
+                        rec["health"] = health_scalars
                     if step_guard is not None:
                         rec["guard"] = step_guard.counters()
                     retry_counters = resilience.retry.counters()
@@ -849,6 +934,11 @@ def train_and_evaluate(config, workdir: str):
                     _host_stream(train_iter), fns.batch_sharding, depth=2
                 )
                 obs.trace.counter("guard_rollbacks", step_guard.rollbacks)
+                if ledger is not None:
+                    ledger.mark_rollback()
+                # Everything up to the step we just abandoned is now a
+                # re-run — the ledger books it as rollback_replay badput.
+                replay_until = max(replay_until, step + 1)
                 step = target
                 continue
 
@@ -890,16 +980,31 @@ def train_and_evaluate(config, workdir: str):
                     "%d, draining the feeder, exiting 0",
                     coordinator.signum, step + 1,
                 )
-                if not saved:
-                    with obs.trace.span("preempt_save", step=step + 1):
-                        ckpt.save(step + 1, jax.device_get(state), force=True)
-                _close_host_iter()
+                if ledger is not None:
+                    ledger.mark_preempted()
+                drain_cm = (
+                    ledger.phase("preempt_drain")
+                    if ledger is not None
+                    else contextlib.nullcontext()
+                )
+                # The force-save inside the drain is carved out into the
+                # ckpt_save bucket by note_io's phase steal.
+                with drain_cm:
+                    if not saved:
+                        with obs.trace.span("preempt_save", step=step + 1):
+                            ckpt.save(
+                                step + 1, jax.device_get(state), force=True
+                            )
+                    _close_host_iter()
                 break
 
             step += 1
 
     ckpt.wait_until_finished()
     writer.flush()
+    # Refresh the summary the cleanup stack already wrote: the async final
+    # checkpoint's wait and the teardown itself belong in the totals.
+    _write_goodput()
     return state
 
 

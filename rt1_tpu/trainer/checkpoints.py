@@ -21,7 +21,8 @@ fault-injection sites make both paths provable in tests and chaos runs.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional
+import time
+from typing import Any, Callable, List, Optional
 
 import orbax.checkpoint as ocp
 
@@ -38,6 +39,13 @@ class CheckpointConfig:
     # Backoff schedule for transient I/O on save/restore; None = no retry
     # (one attempt, errors propagate — the pre-resilience behavior).
     retry: Optional[RetryOptions] = None
+    # Observer for checkpoint I/O wall time: called as on_io(name, seconds)
+    # with name "ckpt_save"/"ckpt_restore" after every logical operation
+    # (retries included in the measured span, failures too — badput is
+    # badput). The train loop hands the goodput ledger's note_io here
+    # (rt1_tpu/obs/goodput.py); exceptions are swallowed — accounting must
+    # never take down checkpointing.
+    on_io: Optional[Callable[[str, float], None]] = None
 
 
 class CheckpointManager:
@@ -62,10 +70,19 @@ class CheckpointManager:
         self._restore_ops = 0
 
     def _io(self, fn, name: str):
-        """Run an I/O closure, retried per the config (or once when off)."""
-        if self._config.retry is None:
-            return fn()
-        return retry_call(fn, options=self._config.retry, name=name)
+        """Run an I/O closure, retried per the config (or once when off);
+        reports the whole operation's wall time (all attempts) to `on_io`."""
+        t0 = time.perf_counter()
+        try:
+            if self._config.retry is None:
+                return fn()
+            return retry_call(fn, options=self._config.retry, name=name)
+        finally:
+            if self._config.on_io is not None:
+                try:
+                    self._config.on_io(name, time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001 - accounting only
+                    pass
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         self._save_ops += 1

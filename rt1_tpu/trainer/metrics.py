@@ -33,15 +33,26 @@ def create_writer(workdir: str, *, just_logging: bool = False):
     )
 
 
-def write_hparams(writer, config: Dict[str, Any]):
-    from clu import metric_writers
+def flatten_hparams(config: Dict[str, Any], parent: str = "") -> Dict[str, Any]:
+    """Nested config dict -> {dotted.key: scalar}.
 
-    hparams = {
-        k: v
-        for k, v in config.items()
-        if isinstance(v, (int, float, str, bool))
-    }
-    writer.write_hparams(hparams)
+    The old top-level isinstance filter silently dropped every nested
+    block (`config.data`, `config.obs`, `config.resilience`, ...) — the
+    TB hparams table showed a handful of top-level scalars and nothing
+    else. Non-scalar leaves (tuples, None placeholders) are still skipped.
+    """
+    out: Dict[str, Any] = {}
+    for k, v in config.items():
+        key = f"{parent}.{k}" if parent else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_hparams(v, key))
+        elif isinstance(v, (int, float, str, bool)):
+            out[key] = v
+    return out
+
+
+def write_hparams(writer, config: Dict[str, Any]):
+    writer.write_hparams(flatten_hparams(config))
 
 
 def log_parameter_overview(params, path: Optional[str] = None):

@@ -28,14 +28,25 @@ class TrainState:
     opt_state: Any
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
 
-    def apply_gradients(self, grads: Any, new_batch_stats: Optional[Any] = None) -> "TrainState":
+    def apply_gradients(
+        self,
+        grads: Any,
+        new_batch_stats: Optional[Any] = None,
+        return_updates: bool = False,
+    ) -> Any:
+        """One optimizer step; with ``return_updates`` also returns the
+        applied update tree (``new_params = params + updates``) — consumed
+        by the model-health pack (rt1_tpu/obs/health.py), which must not
+        read the pre-update params (that would pin the donated input
+        buffers past the in-place optimizer write)."""
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
-        return self.replace(
+        new_state = self.replace(
             step=self.step + 1,
             params=optax.apply_updates(self.params, updates),
             batch_stats=self.batch_stats if new_batch_stats is None else new_batch_stats,
             opt_state=new_opt_state,
         )
+        return (new_state, updates) if return_updates else new_state
 
 
 def create_train_state(

@@ -50,6 +50,10 @@ class TrainStepFns:
     batch_sharding: NamedSharding
     mesh: Mesh
     guarded: bool = False
+    # Entry names of the model-health pack vector riding in the metrics
+    # under obs.health.PACK_KEY (empty when model_health is off). The host
+    # unpacks the fetched vector against these at log steps.
+    health_names: Tuple[str, ...] = ()
 
     def shard_state(self, state: TrainState) -> TrainState:
         return jax.device_put(state, self.state_sharding)
@@ -129,6 +133,8 @@ def make_train_step_fns(
     loss_fn: Optional[Callable] = None,
     guard_nonfinite: bool = False,
     guard_grad_norm_max: float = 0.0,
+    model_health: bool = False,
+    health_group_depth: int = 2,
 ) -> TrainStepFns:
     """Build jitted train/eval steps with explicit in/out shardings.
 
@@ -152,12 +158,44 @@ def make_train_step_fns(
     log steps without ever syncing per step. When the step is healthy the
     select is the identity — the guarded step is numerically identical to
     the unguarded one (pinned in tests/test_resilience_guard.py).
+
+    ``model_health=True`` packs per-layer-group gradient norms, post-
+    optimizer update/param ratios, global param norm, action-logit entropy,
+    and per-action-dimension token accuracy into ONE replicated float32
+    vector under ``metrics[obs.health.PACK_KEY]`` (rt1_tpu/obs/health.py)
+    — computed inside the traced step, fetched only when the host fetches
+    metrics, unpacked against ``fns.health_names``. Same guard discipline
+    as ``guard_nonfinite``: a Python-level gate, so the ``False`` path
+    traces the exact pre-change program (pinned bit-identical in
+    tests/test_obs_health.py).
     """
     if param_rules is None:
         param_rules = shardlib.rt1_parameter_rules()
+    default_rt1_loss = loss_fn is None
     if loss_fn is None:
         def loss_fn(params, batch_stats, batch, rng, train):
             return _loss_fn(model, params, batch_stats, batch, rng, train)
+
+    health_names: Tuple[str, ...] = ()
+    health_action_dims = 0
+    if model_health:
+        from rt1_tpu.obs import health as health_lib
+
+        # Action-logit statistics exist only when the default RT-1 token-CE
+        # closure runs unaccumulated (the accum scan keeps only the loss;
+        # family-override losses have no token logits). The pack layout is
+        # decided here, statically, so host names and traced order agree.
+        if (
+            default_rt1_loss
+            and accum_steps == 1
+            and hasattr(model, "tokens_per_action")
+        ):
+            health_action_dims = int(model.tokens_per_action)
+        health_names = health_lib.pack_names(
+            state.params,
+            depth=health_group_depth,
+            action_dims=health_action_dims,
+        )
     state_sharding = shardlib.shard_pytree(state, mesh, param_rules)
     batch_sh = NamedSharding(mesh, P(batch_axes))
     repl = NamedSharding(mesh, P())
@@ -213,7 +251,12 @@ def make_train_step_fns(
             if getattr(model, "aux_mse_weight", 0.0) > 0:
                 out["aux_mse"] = mse / accum_steps  # mean over micros
 
-        new_state = state.apply_gradients(grads, new_batch_stats=new_bs)
+        if model_health:
+            new_state, updates = state.apply_gradients(
+                grads, new_batch_stats=new_bs, return_updates=True
+            )
+        else:
+            new_state = state.apply_gradients(grads, new_batch_stats=new_bs)
         metrics = {
             "loss": loss,
             "grad_norm": optax_global_norm(grads),
@@ -224,6 +267,19 @@ def make_train_step_fns(
             metrics["moe_aux_loss"] = out["moe_aux_loss"]
         if "aux_mse" in out:  # soft-argmax regression monitor
             metrics["aux_mse"] = out["aux_mse"]
+        if model_health:
+            # One small replicated vector; like every other metric it is
+            # dispatched with the step and fetched only at log steps. Fed
+            # from the optimizer's update tree, NOT (old, new) params —
+            # reading pre-update params would pin the donated buffers.
+            metrics[health_lib.PACK_KEY] = health_lib.compute_pack(
+                updates=updates,
+                new_params=new_state.params,
+                grads=grads,
+                out=out,
+                depth=health_group_depth,
+                action_dims=health_action_dims,
+            )
         return new_state, metrics
 
     def eval_step(state: TrainState, batch: Batch):
@@ -283,6 +339,7 @@ def make_train_step_fns(
         batch_sharding=batch_sh,
         mesh=mesh,
         guarded=guard_nonfinite,
+        health_names=health_names,
     )
 
 

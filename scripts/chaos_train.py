@@ -15,8 +15,11 @@ packed synthetic corpus (CPU, seconds each):
 
 Asserts: every run exits 0, the chaos run reaches the SAME final
 checkpoint step as the reference, the phase-A flight-recorder dump has
-reason "preempt" and shows the guard's device-skip counter, and the retry
-counter recorded at least one checkpoint-save retry. Prints a JSON summary.
+reason "preempt" and shows the guard's device-skip counter, the retry
+counter recorded at least one checkpoint-save retry, and phase A's
+goodput summary (rt1_tpu/obs/goodput.py) attributes nonzero
+preempt-drain and checkpoint-I/O badput with bucket fractions summing to
+100%±1. Prints a JSON summary.
 
 The fault schedule reaches the subprocesses through the ``RT1_FAULTS`` env
 var (rt1_tpu/resilience/faults.py grammar) — the same channel an operator
@@ -215,6 +218,31 @@ def main(argv=None):
         "retry warning missing from phase A logs"
     )
 
+    # Goodput ledger (rt1_tpu/obs/goodput.py): phase A's summary must
+    # attribute the preemption as badput — nonzero preempt_drain bucket,
+    # preempted flag set, and the bucket fractions must sum to 100%±1.
+    # (Read it BEFORE phase B relaunches into the same workdir.)
+    goodput_path = os.path.join(chaos_dir, "goodput_summary.json")
+    assert os.path.exists(goodput_path), "phase A left no goodput summary"
+    with open(goodput_path) as f:
+        goodput_a = json.load(f)
+    assert goodput_a["preempted"] is True, goodput_a
+    preempt_badput_s = goodput_a["buckets_s"]["preempt_drain"]
+    ckpt_badput_s = (
+        goodput_a["buckets_s"]["ckpt_save"]
+        + goodput_a["buckets_s"]["ckpt_restore"]
+    )
+    assert preempt_badput_s > 0, (
+        f"preempt_drain badput not attributed: {goodput_a['buckets_s']}"
+    )
+    assert ckpt_badput_s > 0, (
+        f"checkpoint I/O badput not attributed: {goodput_a['buckets_s']}"
+    )
+    fraction_sum = sum(goodput_a["fractions"].values())
+    assert abs(fraction_sum - 1.0) < 0.01, (
+        f"goodput fractions sum to {fraction_sum}, not 100%±1"
+    )
+
     # 3. Chaos phase B: plain relaunch resumes to the reference's step.
     rc, _ = _run_train(chaos_dir, data_dir, args.steps, verbose=args.verbose)
     assert rc == 0, f"chaos phase B failed (rc={rc})"
@@ -232,6 +260,9 @@ def main(argv=None):
         "guard_device_skips": device_skips,
         "ckpt_save_retries": retry_events,
         "preempt_dump_records": len(records),
+        "preempt_badput_s": round(preempt_badput_s, 3),
+        "ckpt_badput_s": round(ckpt_badput_s, 3),
+        "goodput_pct_phase_a": round(goodput_a["goodput_pct"], 2),
         "packed": not args.synthetic,
     }
     print(json.dumps(summary, indent=2))

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""One post-mortem report per run: goodput + flight recorder + TB scalars.
+
+After a run ends (cleanly, by preemption, or face-down), the evidence is
+scattered: ``goodput_summary.json`` says where the hours went,
+``flight_record.jsonl`` has the last seconds at per-step resolution, and
+the TensorBoard event files hold the scalar history (loss, `health/*`
+model-health gauges, `timing/*` buckets). This script merges the three
+into one human-readable report::
+
+    python scripts/run_report.py --workdir /tmp/run            # stdout
+    python scripts/run_report.py --workdir /tmp/run --out report.md
+
+Every source is optional: a missing file becomes a "not found" note, not
+a crash — the report is most needed exactly when a run died early and
+left only some of the artifacts. TB reading requires tensorboard (present
+wherever clu wrote the events in the first place); without it the scalar
+section degrades to a note.
+
+Tested against canned artifacts in tests/test_run_report.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/run_report.py`
+    sys.path.insert(0, _REPO)
+
+# Goodput bucket reporting order + one-line meanings for the table.
+_BUCKET_NOTES = {
+    "init": "model/dataset/state setup",
+    "compile": "first step (XLA compilation)",
+    "step": "productive train steps (GOODPUT)",
+    "data_stall": "input pipeline wait inside steps",
+    "ckpt_save": "checkpoint saves (retries included)",
+    "ckpt_restore": "checkpoint restores",
+    "rollback_replay": "steps re-run after guard rollback",
+    "preempt_drain": "preemption save-and-drain",
+    "unattributed": "logging/eval/Python between steps",
+}
+
+
+# ------------------------------------------------------------------ loading
+
+
+def load_goodput(workdir: str) -> Optional[Dict[str, Any]]:
+    from rt1_tpu.obs import goodput
+
+    path = os.path.join(workdir, goodput.SUMMARY_BASENAME)
+    if not os.path.exists(path):
+        return None
+    return goodput.read_summary(path)
+
+
+def load_flight(workdir: str) -> Optional[Dict[str, Any]]:
+    from rt1_tpu.obs import recorder
+
+    path = os.path.join(workdir, "flight_record.jsonl")
+    if not os.path.exists(path):
+        return None
+    return recorder.read_dump(path)
+
+
+def load_tb_scalars(workdir: str) -> Optional[Dict[str, Tuple[int, float]]]:
+    """{tag: (last_step, last_value)} from the newest event file, or None
+    when tensorboard is unavailable / no event file exists."""
+    try:
+        from tensorboard.backend.event_processing import event_accumulator
+    except ImportError:
+        return None
+    events = sorted(
+        (
+            os.path.join(root, f)
+            for root, _, files in os.walk(workdir)
+            for f in files
+            if "tfevents" in f
+        ),
+        key=os.path.getmtime,
+    )
+    if not events:
+        return None
+    acc = event_accumulator.EventAccumulator(
+        events[-1],
+        size_guidance={
+            event_accumulator.SCALARS: 0,
+            event_accumulator.TENSORS: 0,
+        },
+    )
+    acc.Reload()
+    out: Dict[str, Tuple[int, float]] = {}
+    for tag in acc.Tags().get("scalars", []):
+        series = acc.Scalars(tag)
+        if series:
+            out[tag] = (int(series[-1].step), float(series[-1].value))
+    # clu's TB writer emits TF2 summaries, which the accumulator files
+    # under "tensors" — decode 0-d tensors back into scalars.
+    from tensorboard.util import tensor_util
+
+    for tag in acc.Tags().get("tensors", []):
+        if tag in out:
+            continue
+        series = acc.Tensors(tag)
+        if not series:
+            continue
+        try:
+            value = tensor_util.make_ndarray(series[-1].tensor_proto)
+        except Exception:  # noqa: BLE001 - non-scalar summary (text, etc.)
+            continue
+        if getattr(value, "size", 0) == 1:
+            out[tag] = (int(series[-1].step), float(value.reshape(())))
+    return out or None
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def _bar(pct: float, width: int = 30) -> str:
+    filled = int(round(max(0.0, min(pct, 100.0)) / 100.0 * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def render_goodput(goodput: Optional[Dict[str, Any]]) -> List[str]:
+    lines = ["## Where the hours went (goodput ledger)", ""]
+    if goodput is None:
+        lines.append(
+            "goodput_summary.json not found — run predates the ledger, or "
+            "died before the first summary write."
+        )
+        return lines
+    wall = goodput.get("wall_s", 0.0)
+    lines.append(f"Wall time: {wall:.1f} s")
+    lines.append("")
+    lines.append(f"{'bucket':<16}{'seconds':>10}  {'share':>6}  ")
+    buckets = goodput.get("buckets_s", {})
+    fractions = goodput.get("fractions", {})
+    for b in _BUCKET_NOTES:
+        if b not in buckets:
+            continue
+        pct = fractions.get(b, 0.0) * 100.0
+        lines.append(
+            f"{b:<16}{buckets[b]:>10.2f}  {pct:>5.1f}%  "
+            f"|{_bar(pct)}|  {_BUCKET_NOTES[b]}"
+        )
+    lines.append("")
+    lines.append(
+        f"Goodput {goodput.get('goodput_pct', 0.0):.1f}% / badput "
+        f"{goodput.get('badput_pct', 0.0):.1f}% of wall time."
+    )
+    if "mfu_pct" in goodput:
+        lines.append(
+            f"MFU {goodput['mfu_pct']:.3f}% "
+            f"({goodput.get('flops_per_step', 0):.3g} FLOPs/step per XLA "
+            f"cost analysis)."
+        )
+    extras = []
+    if goodput.get("rollbacks"):
+        extras.append(
+            f"{goodput['rollbacks']} rollback(s), "
+            f"{goodput.get('steps_replayed', 0)} step(s) replayed"
+        )
+    if goodput.get("preempted"):
+        extras.append("run was PREEMPTED (saved and exited 0)")
+    if extras:
+        lines.append("Events: " + "; ".join(extras) + ".")
+    return lines
+
+
+def render_health(
+    tb: Optional[Dict[str, Tuple[int, float]]]
+) -> List[str]:
+    lines = ["## Model health (last log step)", ""]
+    if tb is None:
+        lines.append(
+            "No TensorBoard events readable (tensorboard missing or no "
+            "event file) — health gauges unavailable here; see the "
+            "Prometheus listener or the flight recorder."
+        )
+        return lines
+    health = {k: v for k, v in tb.items() if k.startswith("health/")}
+    if not health:
+        lines.append(
+            "No health/* scalars in the events — the run had "
+            "config.obs.model_health off."
+        )
+        return lines
+    step = max(s for s, _ in health.values())
+    lines.append(f"As of step {step}:")
+    for tag in sorted(health):
+        lines.append(f"  {tag:<48}{health[tag][1]:>12.5g}")
+    return lines
+
+
+def render_flight(
+    flight: Optional[Dict[str, Any]], tail: int = 8
+) -> List[str]:
+    lines = ["## Flight recorder", ""]
+    if flight is None:
+        lines.append(
+            "flight_record.jsonl not found — the run exited cleanly (the "
+            "recorder only dumps on crash/SIGTERM/preempt)."
+        )
+        return lines
+    header = flight.get("header", {})
+    records = flight.get("records", [])
+    lines.append(
+        f"Dump reason: {header.get('reason', '?')} — {len(records)} of "
+        f"{header.get('recorded_total', '?')} recorded steps retained."
+    )
+    if records:
+        lines.append("")
+        lines.append(
+            f"{'step':>8}{'total_ms':>10}{'stall%':>8}{'loss':>12}"
+        )
+        for rec in records[-tail:]:
+            loss = rec.get("loss")
+            loss_s = f"{loss:>12.4g}" if loss is not None else f"{'-':>12}"
+            lines.append(
+                f"{rec.get('step', '?'):>8}"
+                f"{rec.get('total_ms', float('nan')):>10.1f}"
+                f"{rec.get('stall_pct', float('nan')):>8.1f}"
+                + loss_s
+            )
+        last = records[-1]
+        if "health" in last:
+            lines.append("")
+            lines.append("Health gauges in the final record:")
+            for k in sorted(last["health"]):
+                lines.append(f"  {k:<48}{last['health'][k]:>12.5g}")
+        if "guard" in last:
+            g = last["guard"]
+            lines.append(
+                f"Guard at the end: {g.get('guard/device_skips_total', 0):.0f} "
+                f"device skips, {g.get('guard/rollbacks_total', 0):.0f} "
+                f"rollbacks."
+            )
+    return lines
+
+
+def render_scalars(
+    tb: Optional[Dict[str, Tuple[int, float]]]
+) -> List[str]:
+    lines = ["## Last training scalars", ""]
+    if tb is None:
+        lines.append("No TensorBoard events readable.")
+        return lines
+    wanted = ("loss", "eval_loss", "grad_norm", "stall_pct",
+              "steps_per_sec", "examples_per_sec")
+    found = [(t, tb[t]) for t in wanted if t in tb]
+    if not found:
+        lines.append("None of the standard scalar tags present.")
+        return lines
+    for tag, (step, value) in found:
+        lines.append(f"  {tag:<24}{value:>12.5g}   (step {step})")
+    return lines
+
+
+def render_report(
+    workdir: str,
+    goodput: Optional[Dict[str, Any]],
+    flight: Optional[Dict[str, Any]],
+    tb: Optional[Dict[str, Tuple[int, float]]],
+    tail: int = 8,
+) -> str:
+    sections = [
+        [f"# RT-1 run report — {workdir}", ""],
+        render_goodput(goodput),
+        [""],
+        render_health(tb),
+        [""],
+        render_flight(flight, tail=tail),
+        [""],
+        render_scalars(tb),
+        [""],
+    ]
+    return "\n".join(line for sec in sections for line in sec)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--out", default="",
+                   help="Write the report here instead of stdout.")
+    p.add_argument("--tail", type=int, default=8,
+                   help="Flight-recorder records to show.")
+    args = p.parse_args(argv)
+
+    report = render_report(
+        args.workdir,
+        load_goodput(args.workdir),
+        load_flight(args.workdir),
+        load_tb_scalars(args.workdir),
+        tail=args.tail,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(report + "\n")
+        print(f"run_report: written to {args.out}", file=sys.stderr)
+    else:
+        print(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()

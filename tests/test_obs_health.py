@@ -1,0 +1,209 @@
+"""obs/health.py + the health-pack train step (trainer/train.py).
+
+The contract: with model_health on, the step returns ONE replicated
+float32 vector of finite statistics whose layout matches
+`fns.health_names`; with it off, the step is bit-identical to the
+pre-health program (same discipline as the resilience guard); and the
+pack composes with the guarded step. Plus the train-loop integration:
+health/* scalars reach the TB events, goodput_summary.json lands with
+buckets summing to 100%, and scripts/run_report.py merges it all.
+"""
+
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from rt1_tpu.obs import health
+
+from test_rt1 import make_batch, tiny_policy
+
+
+def _setup(model_health, donate=True, guard=False):
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+    from rt1_tpu.trainer import (
+        create_train_state,
+        make_optimizer,
+        make_train_step_fns,
+    )
+
+    model = tiny_policy()
+    rng = jax.random.PRNGKey(0)
+    obs, actions = make_batch(rng, b=8)
+    tx = make_optimizer(learning_rate=1e-3)
+    state = create_train_state(model, rng, (obs, actions), tx)
+    mesh = make_mesh(MeshConfig())
+    fns = make_train_step_fns(
+        model, mesh, state, model_health=model_health, donate=donate,
+        guard_nonfinite=guard,
+    )
+    return fns, fns.shard_state(state), (obs, actions)
+
+
+# ------------------------------------------------------------- pure module
+
+
+def test_pack_names_layout_is_deterministic():
+    params = {"b": {"x": np.ones(3)}, "a": {"y": np.ones(2), "z": np.ones(2)}}
+    names = health.pack_names(params, depth=1, action_dims=2)
+    assert names == (
+        "health/grad_norm/a",
+        "health/grad_norm/b",
+        "health/update_ratio/a",
+        "health/update_ratio/b",
+        "health/param_norm_global",
+        "health/update_norm_global",
+        "health/logit_entropy",
+        "health/token_acc/dim0",
+        "health/token_acc/dim1",
+    )
+    # No action stats when the builder says there are none.
+    assert health.pack_names(params, depth=1, action_dims=0) == names[:6]
+    # Deeper than the tree: groups bottom out at the leaves, no error.
+    deep = health.param_groups(params, depth=5)
+    assert "a/y" in deep and "b/x" in deep
+
+
+def test_param_groups_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        health.param_groups({"a": np.ones(1)}, depth=0)
+
+
+def test_unpack_rejects_layout_mismatch():
+    with pytest.raises(ValueError):
+        health.unpack(("a", "b"), np.zeros(3))
+
+
+# ----------------------------------------------------------- stepped (jit)
+
+
+def test_health_pack_finite_and_correctly_shaped():
+    fns, state, batch = _setup(model_health=True)
+    assert fns.health_names, "builder produced no health layout"
+    state, metrics = fns.train_step(
+        state, fns.shard_batch(batch), jax.random.PRNGKey(1)
+    )
+    vec = np.asarray(metrics[health.PACK_KEY])
+    assert vec.dtype == np.float32
+    assert vec.shape == (len(fns.health_names),)
+    assert np.isfinite(vec).all()
+
+    scalars = health.unpack(fns.health_names, vec)
+    model = tiny_policy()
+    # Per-dimension token accuracy is a probability; entropy is bounded by
+    # log(vocab); norms are positive on a real gradient step.
+    for k in range(model.tokens_per_action):
+        assert 0.0 <= scalars[f"health/token_acc/dim{k}"] <= 1.0
+    assert 0.0 <= scalars["health/logit_entropy"] <= math.log(
+        model.vocab_size
+    ) + 1e-5
+    assert scalars["health/param_norm_global"] > 0
+    assert scalars["health/update_norm_global"] > 0
+    grad_norms = [
+        v for n, v in scalars.items() if n.startswith("health/grad_norm/")
+    ]
+    ratios = [
+        v for n, v in scalars.items() if n.startswith("health/update_ratio/")
+    ]
+    assert grad_norms and ratios
+    assert all(v >= 0 for v in grad_norms + ratios)
+
+
+def test_health_off_step_is_bit_identical():
+    """The model_health=False path must trace the exact pre-change program:
+    same metrics keys, same params to the ULP as the health-on step's."""
+    fns_on, state_on, batch = _setup(model_health=True, donate=False)
+    fns_off, state_off, _ = _setup(model_health=False, donate=False)
+    assert fns_off.health_names == ()
+    rng = jax.random.PRNGKey(7)
+    state_on, m_on = fns_on.train_step(
+        state_on, fns_on.shard_batch(batch), rng
+    )
+    state_off, m_off = fns_off.train_step(
+        state_off, fns_off.shard_batch(batch), rng
+    )
+    assert health.PACK_KEY in m_on and health.PACK_KEY not in m_off
+    assert float(m_on["loss"]) == float(m_off["loss"])
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_on.params)),
+        jax.tree.leaves(jax.device_get(state_off.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_health_composes_with_guard():
+    from rt1_tpu.resilience import faults
+
+    fns, state, batch = _setup(model_health=True, guard=True)
+    assert fns.guarded and fns.health_names
+    skips = fns.init_guard_skips()
+    state, skips, metrics = fns.train_step(
+        state, skips, fns.shard_batch(batch), jax.random.PRNGKey(1)
+    )
+    assert int(metrics["guard_skips_cum"]) == 0
+    assert np.isfinite(np.asarray(metrics[health.PACK_KEY])).all()
+
+    # A poisoned batch: the update is dropped, and the pack honestly shows
+    # the non-finite statistics of the dropped update (that is the signal).
+    obs, actions = batch
+    bad = fns.shard_batch((faults.poison_batch(obs), actions))
+    state, skips, metrics = fns.train_step(
+        state, skips, bad, jax.random.PRNGKey(2)
+    )
+    assert int(skips) == 1
+    vec = health.unpack(
+        fns.health_names, np.asarray(metrics[health.PACK_KEY])
+    )
+    assert not all(np.isfinite(v) for v in vec.values())
+
+
+# ----------------------------------------------------------- loop e2e
+
+
+@pytest.mark.slow
+def test_train_loop_emits_health_goodput_and_report(tmp_path):
+    """Integration over the tiny synthetic config: health/* scalars land in
+    the TB events, goodput_summary.json's buckets sum to 100%±1 with a live
+    MFU gauge, and run_report merges both into one report."""
+    import sys
+
+    from rt1_tpu.train.configs import tiny
+    from rt1_tpu.train.train import train_and_evaluate
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    import run_report
+
+    config = tiny.get_config()
+    config.data.height, config.data.width = 32, 56
+    config.num_steps = 4
+    config.log_every_steps = 1
+    config.obs.model_health = True
+    config.obs.goodput_mfu = True
+    workdir = str(tmp_path / "run")
+    train_and_evaluate(config, workdir)
+
+    goodput = run_report.load_goodput(workdir)
+    assert goodput is not None
+    assert sum(goodput["fractions"].values()) == pytest.approx(1.0, abs=0.01)
+    assert goodput["steps_productive"] == 3  # step 0 went to compile
+    assert "mfu_pct" in goodput and goodput["flops_per_step"] > 0
+
+    tb = run_report.load_tb_scalars(workdir)
+    assert tb is not None, "no TB events readable"
+    health_tags = [t for t in tb if t.startswith("health/")]
+    assert any("grad_norm" in t for t in health_tags)
+    assert any("update_ratio" in t for t in health_tags)
+    assert "health/logit_entropy" in tb
+    assert "health/token_acc/dim0" in tb
+    goodput_tags = [t for t in tb if t.startswith("goodput/")]
+    assert "goodput/goodput_pct" in goodput_tags
+    assert "goodput/mfu_pct" in goodput_tags
+
+    report = run_report.render_report(
+        workdir, goodput, run_report.load_flight(workdir), tb
+    )
+    assert "Where the hours went" in report
+    assert "health/logit_entropy" in report
+    assert "MFU" in report

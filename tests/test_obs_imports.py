@@ -39,6 +39,24 @@ rec = obs.FlightRecorder(capacity=4)
 rec.record(1, loss=0.5)
 assert len(rec) == 1
 
+# PR 5 modules: health / goodput / flops must import and do host-side work
+# under the same blocker (jax is allowed; clu/tensorboard/tensorflow not).
+clock = iter(range(100)).__next__
+ledger = obs.GoodputLedger(clock=lambda: float(clock()))
+ledger.note_step({"total_ms": 1000.0, "wait_data_ms": 100.0})
+ledger.note_step({"total_ms": 1000.0, "wait_data_ms": 100.0})
+s = ledger.summary()
+assert abs(sum(s["fractions"].values()) - 1.0) < 1e-9
+assert obs.goodput.SUMMARY_BASENAME.endswith(".json")
+
+names = obs.health.pack_names({"a": {"w": [1.0]}}, depth=1, action_dims=2)
+assert names[0] == "health/grad_norm/a"
+assert names[-1] == "health/token_acc/dim1"
+assert obs.health.unpack(("x",), [1.5]) == {"x": 1.5}
+
+assert obs.flops.mfu_pct(100.0, 1.0, n_chips=1, peak_flops=1000.0) == 10.0
+assert obs.flops.cost_analysis_flops([{"flops": 3.0}]) == 3.0
+
 from rt1_tpu.serve.metrics import ServeMetrics
 
 text = ServeMetrics().prometheus_text(active_sessions=0)

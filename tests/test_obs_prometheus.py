@@ -161,3 +161,30 @@ def test_metrics_server_scrape_on_ephemeral_port():
         assert health.read() == b"ok\n"
     finally:
         server.close()
+
+
+def test_health_and_goodput_gauges_exposition():
+    """PR 5 naming contract: the health pack and goodput ledger scalars the
+    train loop merges into its stream render as valid rt1_train_health_* /
+    rt1_train_goodput_* gauges (what the acceptance scrape greps for)."""
+    scalars = {
+        "health/grad_norm/transformer/layer_0": 0.019,
+        "health/update_ratio/transformer/layer_0": 3.6e-3,
+        "health/logit_entropy": 2.46,
+        "health/token_acc/dim0": 0.042,
+        "goodput/step_s": 120.5,
+        "goodput/goodput_pct": 81.3,
+        "goodput/mfu_pct": 37.2,
+        "goodput/rollback_replay_s": 0.0,
+    }
+    text = prom.render_scalar_gauges(scalars)
+    types, samples = parse_exposition(text)
+    by_name = {n: float(v) for n, _, v in samples}
+    assert by_name["rt1_train_health_grad_norm_transformer_layer_0"] == 0.019
+    assert by_name["rt1_train_health_logit_entropy"] == 2.46
+    assert by_name["rt1_train_health_token_acc_dim0"] == 0.042
+    assert by_name["rt1_train_goodput_goodput_pct"] == 81.3
+    assert by_name["rt1_train_goodput_mfu_pct"] == 37.2
+    assert all(
+        types[n] == "gauge" for n in by_name if n.startswith("rt1_train_")
+    )

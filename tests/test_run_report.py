@@ -1,0 +1,167 @@
+"""scripts/run_report.py: post-mortem rendering pinned on canned artifacts.
+
+A golden-ish contract: given a known goodput summary and flight-recorder
+dump, the report's load-bearing lines (bucket rows, badput narrative,
+flight tail, health gauges) must come out exactly — an operator reads
+this under pressure, so format drift is a regression, not cosmetics.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+import run_report  # noqa: E402
+
+from rt1_tpu.obs.goodput import GoodputLedger  # noqa: E402
+from rt1_tpu.obs.recorder import FlightRecorder  # noqa: E402
+
+
+def _canned_workdir(tmp_path):
+    """A workdir as a preempted, once-rolled-back run would leave it."""
+    wd = tmp_path / "run"
+    wd.mkdir()
+
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        return clock["t"]
+
+    led = GoodputLedger(clock=fake_clock)
+    with led.phase("init"):
+        clock["t"] += 8.0
+        led.note_io("ckpt_restore", 2.0)
+    clock["t"] += 20.0
+    led.note_step({"total_ms": 20_000.0, "wait_data_ms": 0.0, "h2d_ms": 0.0})
+    for _ in range(10):
+        clock["t"] += 1.0
+        led.note_step(
+            {"total_ms": 1000.0, "wait_data_ms": 200.0, "h2d_ms": 50.0}
+        )
+    led.mark_rollback()
+    for _ in range(4):
+        clock["t"] += 1.0
+        led.note_step({"total_ms": 1000.0}, replay=True)
+    led.note_io("ckpt_save", 3.0)
+    clock["t"] += 3.0
+    led.mark_preempted()
+    with led.phase("preempt_drain"):
+        clock["t"] += 2.0
+    led.set_flops_per_step(1.5e9, peak_flops=197e12, n_chips=1)
+    led.write_summary(str(wd / "goodput_summary.json"))
+
+    rec = FlightRecorder(capacity=8, path=str(wd / "flight_record.jsonl"))
+    for step in range(30, 42):
+        rec.record(
+            step,
+            total_ms=31.25,
+            stall_pct=12.5,
+            **({"loss": 2.5 - step * 0.01} if step % 2 == 0 else {}),
+        )
+    rec.record(
+        42,
+        total_ms=31.25,
+        stall_pct=12.5,
+        loss=2.08,
+        health={"health/logit_entropy": 2.4587, "health/token_acc/dim0": 0.25},
+        guard={"guard/device_skips_total": 1.0, "guard/rollbacks_total": 1.0},
+    )
+    rec.dump(reason="preempt")
+    return str(wd)
+
+
+def test_report_golden_sections(tmp_path):
+    wd = _canned_workdir(tmp_path)
+    goodput = run_report.load_goodput(wd)
+    flight = run_report.load_flight(wd)
+    report = run_report.render_report(wd, goodput, flight, tb=None, tail=4)
+    lines = report.splitlines()
+
+    # Goodput table rows: fixed-width bucket lines with shares.
+    # Wall: 8 init + 20 compile + 10 productive + 4 replay + 3 between-
+    # steps save + 2 drain = 47 s, every second attributed.
+    assert "Wall time: 47.0 s" in report
+    row = next(ln for ln in lines if ln.startswith("init"))
+    assert row.startswith("init                  6.00   12.8%")
+    assert "model/dataset/state setup" in row
+    row = next(ln for ln in lines if ln.startswith("rollback_replay"))
+    assert "4.00" in row and "steps re-run after guard rollback" in row
+    assert any(
+        ln.startswith("step") and "GOODPUT" in ln for ln in lines
+    )
+    # Narrative: goodput%, MFU, events.
+    assert "Goodput 16.0% / badput 84.0% of wall time." in report
+    assert "MFU" in report and "1.5e+09 FLOPs/step" in report
+    assert "1 rollback(s), 4 step(s) replayed" in report
+    assert "PREEMPTED" in report
+
+    # Flight tail: capacity 8 with 13 records -> 8 retained, tail of 4.
+    assert "Dump reason: preempt — 8 of 13 recorded steps retained." in report
+    assert "      42      31.2    12.5        2.08" in report
+    assert "      39      31.2    12.5           -" in report
+    # Health gauges embedded in the final record surface in the report.
+    assert "health/logit_entropy" in report and "2.4587" in report
+    assert "Guard at the end: 1 device skips, 1 rollbacks." in report
+
+    # TB-less degradation is a note, not a crash.
+    assert "No TensorBoard events readable" in report
+
+
+def test_report_all_sources_missing(tmp_path):
+    wd = str(tmp_path / "empty")
+    os.makedirs(wd)
+    report = run_report.render_report(
+        wd,
+        run_report.load_goodput(wd),
+        run_report.load_flight(wd),
+        run_report.load_tb_scalars(wd),
+    )
+    assert "goodput_summary.json not found" in report
+    assert "flight_record.jsonl not found" in report
+
+
+def test_main_writes_out_file(tmp_path, capsys):
+    wd = _canned_workdir(tmp_path)
+    out = str(tmp_path / "report.md")
+    run_report.main(["--workdir", wd, "--out", out])
+    with open(out) as f:
+        text = f.read()
+    assert text.startswith(f"# RT-1 run report — {wd}")
+    # stdout stays clean when --out is given (stderr gets the note).
+    assert "Where the hours went" not in capsys.readouterr().out
+
+
+def test_goodput_fractions_always_renderable(tmp_path):
+    """A summary whose fractions were hand-edited out of range must not
+    crash the bar renderer (clamped, not asserted)."""
+    wd = tmp_path / "run"
+    wd.mkdir()
+    summary = {
+        "wall_s": 10.0,
+        "buckets_s": {b: 0.0 for b in run_report._BUCKET_NOTES},
+        "fractions": {b: 0.0 for b in run_report._BUCKET_NOTES},
+        "goodput_pct": 0.0,
+        "badput_pct": 100.0,
+        "steps_productive": 0,
+        "steps_replayed": 0,
+        "rollbacks": 0,
+        "preempted": False,
+    }
+    summary["fractions"]["step"] = 1.7  # corrupt
+    with open(wd / "goodput_summary.json", "w") as f:
+        json.dump(summary, f)
+    report = run_report.render_report(
+        str(wd), run_report.load_goodput(str(wd)), None, None
+    )
+    assert "170.0%" in report  # reported honestly, bar clamped
+
+
+def test_bar_rendering_bounds():
+    assert run_report._bar(0.0) == "." * 30
+    assert run_report._bar(100.0) == "#" * 30
+    assert run_report._bar(250.0) == "#" * 30
+    assert len(run_report._bar(33.3)) == 30

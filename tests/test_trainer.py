@@ -194,3 +194,42 @@ def test_eval_step_metrics():
     metrics = fns.eval_step(state, batch)
     assert set(metrics) >= {"loss", "token_accuracy"}
     assert 0.0 <= float(metrics["token_accuracy"]) <= 1.0
+
+
+def test_write_hparams_flattens_nested_configs():
+    """Regression: nested config blocks (config.data, config.obs, ...) were
+    silently dropped by the top-level scalar filter — the TB hparams table
+    lost everything an operator actually tunes. Nested dicts now flatten to
+    dotted keys; non-scalar leaves (tuples, None placeholders) still skip."""
+    from rt1_tpu.trainer.metrics import flatten_hparams, write_hparams
+
+    config = {
+        "learning_rate": 5e-4,
+        "seed": 42,
+        "lr_milestones": (50, 75, 90),  # non-scalar: skipped
+        "data": {
+            "height": 256,
+            "packed_cache": True,
+            "packed_cache_dir": None,  # placeholder: skipped
+        },
+        "obs": {"model_health": True, "prometheus_host": "127.0.0.1"},
+        "model": {"lava": {"d_model": 128}},
+    }
+    flat = flatten_hparams(config)
+    assert flat == {
+        "learning_rate": 5e-4,
+        "seed": 42,
+        "data.height": 256,
+        "data.packed_cache": True,
+        "obs.model_health": True,
+        "obs.prometheus_host": "127.0.0.1",
+        "model.lava.d_model": 128,
+    }
+
+    class FakeWriter:
+        def write_hparams(self, hparams):
+            self.hparams = hparams
+
+    writer = FakeWriter()
+    write_hparams(writer, config)
+    assert writer.hparams == flat
