@@ -103,6 +103,33 @@ def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
     return PolicyEngine(model, variables, **engine_kwargs), restored_step
 
 
+def load_standby_variables(config, workdir=None, step=None):
+    """Restore a checkpoint (or re-init when `workdir` is None) into HOST
+    buffers for a zero-downtime engine hot-swap.
+
+    Returns (variables, checkpoint_step) with every leaf a numpy array —
+    the standby buffer `PolicyEngine.swap_variables` validates before any
+    device memory is touched, so a corrupt checkpoint is rejected while
+    the old params keep serving. `workdir=None` rebuilds the same
+    deterministic PRNGKey(0) random init as `build_serve_engine`'s
+    random-init path (bit-identical params — the chaos harness uses this
+    to prove reload parity without a trained checkpoint). checkpoint_step
+    is -1 for random init.
+    """
+    import jax
+    import numpy as np
+
+    if workdir is None:
+        _, state, _, _ = build_model_and_state(config)
+        variables, restored_step = _variables_from_state(state), -1
+    else:
+        _, variables, restored_step, _, _ = restore_variables(
+            config, workdir, step=step
+        )
+    host = jax.tree.map(lambda x: np.asarray(x), variables)
+    return host, restored_step
+
+
 def restore_eval_policy(config, train_dir: str, step: int | None = None):
     """Build the model from `config.model`, restore `train_dir/checkpoints`
     (newest step unless `step` is given), and return an `RT1EvalPolicy`.

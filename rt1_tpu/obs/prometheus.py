@@ -122,6 +122,10 @@ _SERVE_COUNTERS = {
     "errors_total": "Requests answered with an error status.",
     "rejected_total": "Requests shed by queue backpressure (503 busy).",
     "resets_total": "Session resets via /reset.",
+    "reloads_total": "Zero-downtime checkpoint hot-swaps served.",
+    "sessions_restarted_total": (
+        "Sessions re-homed to another replica after theirs died."
+    ),
     "batches_total": "Batched device steps executed.",
 }
 

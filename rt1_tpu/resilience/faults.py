@@ -22,6 +22,14 @@ Spec grammar (comma-separated)::
                          (simulated deadlock; pairs with the feeder's
                          stall-timeout diagnosis)
     sigterm@5            deliver SIGTERM to this process at train step 5
+    replica_kill@2       serve fleet: SIGKILL a serving replica at chaos
+                         tick 2 (ticks count supervision cycles after the
+                         fleet first reports all-ready; see serve/fleet.py)
+    replica_hang@3       serve fleet: SIGSTOP a replica at chaos tick 3 —
+                         alive to the OS, black-holes requests until the
+                         supervisor's hang detector kills and respawns it
+    serve_reload@4       serve fleet: start a rolling checkpoint reload
+                         (one replica at a time) at chaos tick 4
     <site>@<n>x<k>       fire on k consecutive occurrences starting at n
                          (e.g. nan_batch@3x4 poisons batches 3,4,5,6)
 
@@ -68,6 +76,9 @@ KNOWN_SITES = (
     "feeder_kill",
     "feeder_hang",
     "sigterm",
+    "replica_kill",
+    "replica_hang",
+    "serve_reload",
 )
 
 

@@ -2,17 +2,27 @@
 
 The train→eval→serve third leg (docs/serving.md): `PolicyEngine` holds many
 sessions' rolling network state as slots of one donated device batch and
-steps them in a single AOT-compiled call; `MicroBatcher` coalesces
-concurrent requests under a latency deadline with bounded-queue
+steps them in a single AOT-compiled call (params are a swappable input —
+`swap_variables` hot-swaps checkpoints with zero downtime); `MicroBatcher`
+coalesces concurrent requests under a latency deadline with bounded-queue
 backpressure; `server.py` exposes the stdlib HTTP frontend
 (`python -m rt1_tpu.serve`); `metrics.py` tracks latency/occupancy/
 throughput in `trainer/metrics.py` writer conventions.
+
+Fleet layer (docs/serving.md "Fleet"): `router.py` routes sessions across
+N replicas with affinity, health-aware placement, bounded failover, and
+rolling reload; `fleet.py` (`python -m rt1_tpu.serve.fleet`) spawns and
+supervises the replica processes with deterministic chaos injection from
+`rt1_tpu/resilience/faults.py`; `stub.py` is the model-free replica double
+the fleet tests and accelerator-less rehearsals run against.
 """
 
 from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
 from rt1_tpu.serve.engine import PolicyEngine, SessionError
 from rt1_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from rt1_tpu.serve.router import Replica, Router, make_router_server
 from rt1_tpu.serve.server import (
+    ReloadInProgressError,
     ServeApp,
     install_signal_handlers,
     make_server,
@@ -27,6 +37,10 @@ __all__ = [
     "SessionError",
     "LatencyHistogram",
     "ServeMetrics",
+    "Replica",
+    "Router",
+    "make_router_server",
+    "ReloadInProgressError",
     "ServeApp",
     "install_signal_handlers",
     "make_server",

@@ -19,6 +19,54 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
+import time
+
+
+def _start_checkpoint_watcher(
+    app, workdir: str, interval_s: float, served_step
+) -> None:
+    """Poll the checkpoint dir; hot-swap when a newer step appears.
+
+    The push-free alternative to `POST /reload`: a training job saving into
+    `workdir` rolls onto the fleet automatically. `served_step` is the step
+    the server actually restored at boot — seeding from a fresh
+    latest_step() here would silently skip a checkpoint saved during the
+    (long) jax boot + AOT warmup. Daemon thread, restore errors
+    logged-and-skipped (the old params keep serving; the next poll
+    retries).
+    """
+    import os
+
+    from rt1_tpu.trainer.checkpoints import latest_step
+
+    directory = os.path.join(os.path.abspath(workdir), "checkpoints")
+
+    def _watch():
+        served = served_step if served_step is not None and served_step >= 0 \
+            else None
+        while True:
+            time.sleep(interval_s)
+            try:
+                newest = latest_step(directory)
+                if newest is not None and (served is None or newest > served):
+                    result = app.reload(newest)
+                    served = result["checkpoint_step"]
+                    print(
+                        json.dumps({"status": "reloaded", **result}),
+                        flush=True,
+                    )
+            except Exception as exc:  # noqa: BLE001 - keep watching
+                print(
+                    json.dumps(
+                        {"status": "reload_failed", "error": str(exc)}
+                    ),
+                    flush=True,
+                )
+
+    threading.Thread(
+        target=_watch, name="rt1-serve-ckpt-watcher", daemon=True
+    ).start()
 
 
 def main(argv):
@@ -60,6 +108,20 @@ def main(argv):
         max_sessions=FLAGS.max_sessions,
         embedder=get_embedder(FLAGS.embedder),
     )
+
+    # Standby restore source for zero-downtime hot-swap (POST /reload and
+    # the optional watcher). Random-init replicas rebuild the same
+    # deterministic init — the chaos harness hot-swaps bit-identical
+    # params to prove the mechanism without a trained checkpoint.
+    from rt1_tpu.eval.restore import load_standby_variables
+
+    reload_workdir = None if FLAGS.random_init else FLAGS.workdir
+
+    def reload_fn(reload_step):
+        return load_standby_variables(
+            config, workdir=reload_workdir, step=reload_step
+        )
+
     app = ServeApp(
         engine,
         image_shape=(config.data.height, config.data.width, 3),
@@ -67,8 +129,14 @@ def main(argv):
         max_delay_s=FLAGS.max_delay_ms / 1e3,
         max_queue=FLAGS.max_queue,
         request_timeout_s=FLAGS.request_timeout_s,
+        replica_id=FLAGS.replica_id,
+        reload_fn=reload_fn,
     )
     app.start(warmup=True)
+    if FLAGS.watch_checkpoints_s > 0 and not FLAGS.random_init:
+        _start_checkpoint_watcher(app, FLAGS.workdir,
+                                  FLAGS.watch_checkpoints_s,
+                                  served_step=step)
     httpd = make_server(app, host=FLAGS.host, port=FLAGS.port,
                         quiet=not FLAGS.verbose)
     install_signal_handlers(app, httpd)
@@ -78,6 +146,7 @@ def main(argv):
                 "status": "serving",
                 "host": httpd.server_address[0],
                 "port": httpd.server_address[1],
+                "replica_id": FLAGS.replica_id,
                 "checkpoint_step": step,
                 "max_sessions": engine.max_sessions,
                 "compile_count": engine.compile_count,
@@ -123,6 +192,14 @@ if __name__ == "__main__":
         "Bounded admission queue; beyond this /act returns 503 busy.")
     flags.DEFINE_float(
         "request_timeout_s", 60.0, "Server-side per-request timeout.")
+    flags.DEFINE_integer(
+        "replica_id", 0,
+        "This replica's id within a fleet (rt1_tpu.serve.fleet sets it); "
+        "surfaced in /healthz and the replica_id metrics gauge.")
+    flags.DEFINE_float(
+        "watch_checkpoints_s", 0.0,
+        "Poll the workdir checkpoint dir this often and hot-swap newer "
+        "steps automatically (0 = off; ignored with --random_init).")
     flags.DEFINE_string(
         "embedder", "hash",
         "Instruction embedder spec (hash | ngram | use | table.npz).")

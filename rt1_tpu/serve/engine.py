@@ -17,6 +17,17 @@ so exactly one XLA compilation of the batched step ever happens — a later
 shape mismatch is a hard error, not a silent recompile. The state argument
 is donated: the rolling window updates in place on device, no per-step copy.
 
+The model parameters are an **argument** of the compiled step, not a
+closure capture — a captured array would be baked into the executable as a
+constant, making a checkpoint reload a recompile. Because they are an
+input (undonated, so they survive every call), `swap_variables` can
+hot-swap a newly restored checkpoint between two batches: validate the new
+tree in a standby host buffer (structure, shapes, dtypes, finiteness),
+transfer it to the device off the request path, then atomically repoint
+the engine under the lock. In-flight batches finish on the old params, the
+next batch runs on the new ones, and the single-compile invariant holds
+across any number of reloads.
+
 Host-side the engine adds the serving conveniences the eval policy never
 needed: session→slot assignment with LRU reclaim, per-slot reset, action
 de-normalization/clipping, and an LRU instruction-embedding cache keyed by
@@ -65,7 +76,10 @@ class PolicyEngine:
             raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
         self._jax = jax
         self._model = model
-        self._variables = variables
+        # Device-resident params, passed to the compiled step as an
+        # argument (see swap_variables); device_put is a no-op for arrays
+        # already on device.
+        self._variables = jax.device_put(variables)
         self.max_sessions = max_sessions
         self.action_mean = action_mean
         self.action_std = action_std
@@ -101,6 +115,7 @@ class PolicyEngine:
         self._compiled = None
         self._compiled_obs_shapes: Optional[Dict[str, Tuple]] = None
         self.compile_count = 0
+        self.reloads = 0  # successful swap_variables hot-swaps
 
     # ------------------------------------------------------------ embedding
 
@@ -146,9 +161,9 @@ class PolicyEngine:
         import jax
         import jax.numpy as jnp
 
-        model, variables = self._model, self._variables
+        model = self._model
 
-        def single_step(obs, state):
+        def single_step(variables, obs, state):
             # One slot == one batch-1 infer_step; vmap gives each lane its
             # own scalar seq_idx (per-slot roll phase), which the batched
             # state pytree cannot express directly.
@@ -169,8 +184,12 @@ class PolicyEngine:
             }
             return out, new_state
 
-        def batched_step(obs, active, state):
-            out, stepped = jax.vmap(single_step)(obs, state)
+        def batched_step(variables, obs, active, state):
+            # Params are an argument (broadcast over slots, NOT donated) so
+            # swap_variables can hand the same executable a new checkpoint.
+            out, stepped = jax.vmap(single_step, in_axes=(None, 0, 0))(
+                variables, obs, state
+            )
 
             def gate(new, old):
                 mask = active.reshape(
@@ -184,6 +203,9 @@ class PolicyEngine:
             return out, jax.tree.map(gate, stepped, state)
 
         n = self.max_sessions
+        var_spec = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._variables
+        )
         obs_spec = {
             k: jax.ShapeDtypeStruct((n,) + tuple(shape), np.float32)
             for k, shape in obs_shapes.items()
@@ -192,8 +214,8 @@ class PolicyEngine:
         state_spec = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state
         )
-        lowered = jax.jit(batched_step, donate_argnums=(2,)).lower(
-            obs_spec, active_spec, state_spec
+        lowered = jax.jit(batched_step, donate_argnums=(3,)).lower(
+            var_spec, obs_spec, active_spec, state_spec
         )
         self._compiled = lowered.compile()
         self._compiled_obs_shapes = dict(obs_shapes)
@@ -227,6 +249,80 @@ class PolicyEngine:
                 f"step {self._compiled_obs_shapes}; the engine serves one "
                 "fixed shape per process (pad/resize client-side)"
             )
+
+    # ------------------------------------------------------------ hot-swap
+
+    def swap_variables(self, new_variables) -> Dict[str, Any]:
+        """Zero-downtime checkpoint hot-swap: validate `new_variables` in a
+        standby host buffer, move them to the device, then atomically
+        repoint the compiled step's param argument between batches.
+
+        The expensive phases (host validation, H2D transfer) run OUTSIDE
+        the engine lock, so in-flight `act_batch` calls are never stalled;
+        only the final pointer swap takes the lock. Because the params are
+        an undonated input of the AOT-compiled executable — identical
+        shapes/dtypes are enforced here — no recompile can occur: the
+        single-compile invariant survives any number of reloads. Raises
+        ValueError (engine untouched, old params keep serving) on a
+        structure/shape/dtype mismatch or a non-finite leaf.
+        """
+        import numpy as np
+        from jax import tree_util
+
+        jax = self._jax
+        current = [
+            (tree_util.keystr(path), leaf)
+            for path, leaf in tree_util.tree_flatten_with_path(
+                self._variables
+            )[0]
+        ]
+        standby = [
+            (tree_util.keystr(path), np.asarray(leaf))
+            for path, leaf in tree_util.tree_flatten_with_path(
+                new_variables
+            )[0]
+        ]
+        if [p for p, _ in current] != [p for p, _ in standby]:
+            raise ValueError(
+                "swap_variables: parameter tree structure differs from the "
+                f"serving tree ({len(standby)} vs {len(current)} leaves); "
+                "hot-swap requires a checkpoint of the same model"
+            )
+        for (path, old), (_, new) in zip(current, standby):
+            if tuple(old.shape) != tuple(new.shape) or old.dtype != new.dtype:
+                raise ValueError(
+                    f"swap_variables: leaf {path!r} is "
+                    f"{new.shape}/{new.dtype}, serving "
+                    f"{tuple(old.shape)}/{old.dtype} — a shape or dtype "
+                    "change would force a recompile; rejected"
+                )
+        bad = [
+            path
+            for path, leaf in standby
+            if np.issubdtype(leaf.dtype, np.floating)
+            and not np.isfinite(leaf).all()
+        ]
+        if bad:
+            raise ValueError(
+                f"swap_variables: non-finite values in {bad[:4]} "
+                f"({len(bad)} leaves) — refusing to serve a corrupt "
+                "checkpoint; old params stay live"
+            )
+        # Rebuild on the SERVING treedef (a restored checkpoint may arrive
+        # as plain dicts while the engine was built from a FrozenDict —
+        # the AOT executable matches treedefs exactly, not just key paths).
+        treedef = jax.tree.structure(self._variables)
+        device = jax.device_put(
+            jax.tree.unflatten(treedef, [leaf for _, leaf in standby])
+        )
+        jax.block_until_ready(device)  # pay the H2D cost off the swap
+        with self._lock:
+            self._variables = device
+            self.reloads += 1
+        return {
+            "params_swapped": len(standby),
+            "param_bytes": int(sum(leaf.nbytes for _, leaf in standby)),
+        }
 
     # ------------------------------------------------------------ sessions
 
@@ -426,7 +522,7 @@ class PolicyEngine:
                         active[slot] = True
 
                     out, self._state = self._compiled(
-                        batch_obs, active, self._state
+                        self._variables, batch_obs, active, self._state
                     )
 
                     actions = np.asarray(out["action"])

@@ -1,0 +1,504 @@
+"""Fleet supervisor: spawn, watch, restart, and chaos-test serving replicas.
+
+`python -m rt1_tpu.serve.fleet --replicas 3 --config ... --random_init`
+brings up N replica processes (`python -m rt1_tpu.serve`, or the model-free
+stub with `--stub`), fronts them with the session-affine `Router`
+(`serve/router.py`), and runs a supervision loop:
+
+* **Warm-up gating.** A spawned replica is routable only after it prints
+  the ready-line (which carries its ephemeral port) AND its `/readyz`
+  returns 200 — a replica still paying jax import or the AOT compile never
+  sees traffic, on first boot and on every restart alike.
+* **Death and hang detection.** Every poll cycle checks `proc.poll()`
+  (crash/kill) and probes `/readyz`. A process that is alive to the OS but
+  black-holing probes (`replica_hang` chaos = SIGSTOP, a wedged runtime in
+  production) accumulates consecutive probe failures and is SIGKILLed and
+  respawned — SIGKILL because a stopped process cannot run a SIGTERM
+  handler. Either way the router orphans its sessions immediately; their
+  next `/act` re-homes with ``"restarted": true``.
+* **Deterministic chaos.** The supervisor consults the PR 4 fault registry
+  (`rt1_tpu/resilience/faults.py`, sites `replica_kill` / `replica_hang` /
+  `serve_reload`) once per **chaos tick** — one tick every
+  `chaos_interval_s`, counted only after the fleet first reports
+  all-ready, with the tick ordinal as the fault index. Same plan, same
+  failure schedule, every run: `replica_kill@1,serve_reload@2` always
+  kills at tick 1 and rolls a reload at tick 2. Victim selection is
+  deterministic too (lowest-id ready replica).
+
+The supervisor owns processes, the router owns routing state; they meet at
+the shared `Replica` objects. `scripts/serve_loadgen.py --fleet N` drives
+this module as a subprocess and turns the chaos run into
+`BENCH_serve_fleet.json`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from rt1_tpu.resilience import faults
+from rt1_tpu.serve.router import (
+    DEAD,
+    NOTREADY,
+    READY,
+    STARTING,
+    Replica,
+    Router,
+    get_json,
+    make_router_server,
+)
+
+
+class FleetSupervisor:
+    """Owns N replica subprocesses on behalf of a Router."""
+
+    def __init__(
+        self,
+        router: Router,
+        spawn_argv_fn: Callable[[int], List[str]],
+        n_replicas: int,
+        *,
+        poll_interval_s: float = 0.25,
+        chaos_interval_s: float = 2.0,
+        warmup_timeout_s: float = 600.0,
+        hang_probe_failures: int = 3,
+        probe_timeout_s: float = 2.0,
+        max_restarts: int = 50,
+        log_dir: Optional[str] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+    ):
+        self.router = router
+        self._spawn_argv_fn = spawn_argv_fn
+        self.n_replicas = n_replicas
+        self.poll_interval_s = poll_interval_s
+        self.chaos_interval_s = chaos_interval_s
+        self.warmup_timeout_s = warmup_timeout_s
+        self.hang_probe_failures = hang_probe_failures
+        self.probe_timeout_s = probe_timeout_s
+        self.max_restarts = max_restarts
+        self.log_dir = log_dir
+        self.extra_env = extra_env
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Chaos bookkeeping (summary + determinism evidence). Mutated only
+        # on the single supervisor thread; readers (summary, tests)
+        # tolerate a stale int — no lock needed or implied.
+        self.chaos_tick = 0
+        self._fleet_was_ready = False
+        self.kills_injected = 0
+        self.hangs_injected = 0
+        self.reloads_injected = 0
+        self.restarts_total = 0
+
+    # ------------------------------------------------------------ spawning
+
+    def _spawn(self, replica: Replica) -> None:
+        """(Re)launch one replica; its ready-line reader runs on a thread."""
+        argv = self._spawn_argv_fn(replica.id)
+        stderr = None
+        if self.log_dir is not None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(
+                self.log_dir,
+                f"replica{replica.id}.g{replica.restarts}.log",
+            )
+            stderr = open(path, "w")  # noqa: SIM115 - closed after Popen
+        env = dict(os.environ)
+        if self.extra_env:
+            env.update(self.extra_env)
+        replica.url = None
+        replica.state = STARTING
+        replica.consecutive_probe_failures = 0
+        try:
+            replica.proc = subprocess.Popen(
+                argv,
+                stdout=subprocess.PIPE,
+                stderr=stderr,
+                text=True,
+                env=env,
+            )
+        finally:
+            if stderr is not None:
+                # Popen dup'd the fd into the child; keeping the parent's
+                # copy open would leak one fd per (re)spawn.
+                stderr.close()
+        threading.Thread(
+            target=self._read_ready_line,
+            args=(replica, replica.proc),
+            name=f"rt1-fleet-stdout-{replica.id}",
+            daemon=True,
+        ).start()
+
+    def _read_ready_line(self, replica: Replica, proc) -> None:
+        """Parse `{"status": "serving", "port": ...}` off the replica's
+        stdout, then keep draining so the pipe never fills."""
+        try:
+            for line in proc.stdout:
+                if replica.url is None:
+                    try:
+                        ready = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if ready.get("status") == "serving":
+                        host = ready.get("host", "127.0.0.1")
+                        replica.url = f"http://{host}:{ready['port']}"
+        except (ValueError, OSError):
+            pass  # closed pipe on kill/shutdown
+
+    def start(self, wait_ready: bool = True) -> None:
+        for i in range(self.n_replicas):
+            self.router.add_replica(Replica(i))
+        for replica in self.router.replicas():
+            self._spawn(replica)
+        if wait_ready:
+            try:
+                self.wait_all_ready()
+            except BaseException:
+                # A failed warm-up (one replica crashed, bad config, ...)
+                # must not leak the siblings that DID spawn.
+                self.stop()
+                raise
+        self._thread = threading.Thread(
+            target=self._supervise, name="rt1-fleet-supervisor", daemon=True
+        )
+        self._thread.start()
+
+    def wait_all_ready(self) -> None:
+        """Block until every replica passes warm-up (ready-line + /readyz),
+        raising if one dies or the warm-up budget expires."""
+        deadline = time.monotonic() + self.warmup_timeout_s
+        pending = {r.id for r in self.router.replicas()}
+        while pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replicas {sorted(pending)} not ready after "
+                    f"{self.warmup_timeout_s:.0f}s"
+                )
+            for replica in self.router.replicas():
+                if replica.id not in pending:
+                    continue
+                if replica.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica {replica.id} exited rc="
+                        f"{replica.proc.returncode} during warm-up"
+                        + (
+                            f" (see {self.log_dir})"
+                            if self.log_dir
+                            else ""
+                        )
+                    )
+                if self._probe_ready(replica):
+                    pending.discard(replica.id)
+            time.sleep(0.05)
+
+    def _probe_ready(self, replica: Replica) -> bool:
+        if replica.url is None:
+            return False
+        status, _ = get_json(
+            replica.url + "/readyz", timeout=self.probe_timeout_s
+        )
+        if status == 200:
+            replica.consecutive_probe_failures = 0
+            self.router.set_state(replica.id, READY)
+            return True
+        return False
+
+    # --------------------------------------------------------- supervision
+
+    def _supervise(self) -> None:
+        last_chaos = time.monotonic()
+        while not self._stop.is_set():
+            for replica in self.router.replicas():
+                try:
+                    self._check_replica(replica)
+                except Exception as exc:  # noqa: BLE001 - keep healing
+                    # One bad cycle (full-disk log open, a wait()
+                    # timeout) must not kill supervision for good — a
+                    # dead supervisor means no respawns and a silently
+                    # decaying fleet.
+                    print(
+                        json.dumps(
+                            {
+                                "status": "supervise_error",
+                                "replica": replica.id,
+                                "error": str(exc),
+                            }
+                        ),
+                        file=sys.stderr,
+                        flush=True,
+                    )
+            if not self._fleet_was_ready:
+                # Chaos ticks start only once the fleet has been fully
+                # ready once — fault indices then count ticks, making
+                # the schedule independent of warm-up wall time.
+                self._fleet_was_ready = self.router.ready_count() == (
+                    self.n_replicas
+                )
+                last_chaos = time.monotonic()
+            elif time.monotonic() - last_chaos >= self.chaos_interval_s:
+                last_chaos = time.monotonic()
+                self.chaos_tick += 1
+                try:
+                    self._inject_chaos(self.chaos_tick)
+                except Exception as exc:  # noqa: BLE001 - see above
+                    print(
+                        json.dumps(
+                            {
+                                "status": "chaos_error",
+                                "tick": self.chaos_tick,
+                                "error": str(exc),
+                            }
+                        ),
+                        file=sys.stderr,
+                        flush=True,
+                    )
+            self._stop.wait(self.poll_interval_s)
+
+    def _check_replica(self, replica: Replica) -> None:
+        if replica.proc is None:
+            return
+        if replica.proc.poll() is not None:
+            if replica.state != DEAD:
+                self.router.mark_dead(replica, reason="process exited")
+            self._respawn(replica)
+            return
+        if replica.url is None:
+            return  # still booting, ready-line not printed yet
+        status, _ = get_json(
+            replica.url + "/readyz", timeout=self.probe_timeout_s
+        )
+        if status == 200:
+            replica.consecutive_probe_failures = 0
+            if replica.state != READY:
+                self.router.set_state(replica.id, READY)
+        elif status == 0:
+            replica.consecutive_probe_failures += 1
+            if replica.consecutive_probe_failures >= self.hang_probe_failures:
+                # Alive to the OS, dead to HTTP: hung. SIGKILL (a stopped
+                # process cannot run SIGTERM handlers) and respawn.
+                self.router.mark_dead(replica, reason="hang detected")
+                replica.proc.kill()
+                replica.proc.wait(timeout=10)
+                self._respawn(replica)
+        else:  # a live 503: warming / draining / reloading
+            replica.consecutive_probe_failures = 0
+            if replica.state == READY:
+                self.router.set_state(replica.id, NOTREADY)
+
+    def _respawn(self, replica: Replica) -> None:
+        if self.restarts_total >= self.max_restarts:
+            return  # crash-looping fleet: stop burning the host
+        self.restarts_total += 1
+        replica.restarts += 1
+        self._spawn(replica)
+
+    # --------------------------------------------------------------- chaos
+
+    def _inject_chaos(self, tick: int) -> None:
+        plan = faults.active()
+        if plan is None:
+            return
+        if plan.should_fire("replica_kill", index=tick):
+            victim = self._victim()
+            if victim is not None:
+                self.kills_injected += 1
+                self.router.mark_dead(victim, reason="chaos replica_kill")
+                victim.proc.kill()
+        if plan.should_fire("replica_hang", index=tick):
+            victim = self._victim()
+            if victim is not None:
+                self.hangs_injected += 1
+                victim.proc.send_signal(signal.SIGSTOP)
+        if plan.should_fire("serve_reload", index=tick):
+            self.reloads_injected += 1
+            threading.Thread(
+                target=self.router.rolling_reload,
+                name="rt1-fleet-chaos-reload",
+                daemon=True,
+            ).start()
+
+    def _victim(self) -> Optional[Replica]:
+        ready = [
+            r for r in self.router.replicas()
+            if r.state == READY and r.proc is not None
+        ]
+        return min(ready, key=lambda r: r.id) if ready else None
+
+    # ------------------------------------------------------------ shutdown
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        for replica in self.router.replicas():
+            proc = replica.proc
+            if proc is None or proc.poll() is not None:
+                continue
+            proc.send_signal(signal.SIGCONT)  # un-wedge a SIGSTOP victim
+            proc.terminate()
+        for replica in self.router.replicas():
+            proc = replica.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "chaos_ticks": self.chaos_tick,
+            "kills_injected": self.kills_injected,
+            "hangs_injected": self.hangs_injected,
+            "reloads_injected": self.reloads_injected,
+            "replica_restarts": self.restarts_total,
+            "faults_fired": (
+                faults.active().fired_counts() if faults.active() else {}
+            ),
+        }
+
+
+# -------------------------------------------------------------- entry point
+
+
+def replica_argv_builder(args) -> Callable[[int], List[str]]:
+    """argv factory for one replica — the stub or the real server."""
+    if args.stub:
+        def build(replica_id: int) -> List[str]:
+            return [
+                sys.executable, "-m", "rt1_tpu.serve.stub",
+                "--port", "0",
+                "--replica_id", str(replica_id),
+                "--max_sessions", str(args.max_sessions),
+                "--act_delay_s", str(args.stub_act_delay_s),
+            ]
+        return build
+
+    def build(replica_id: int) -> List[str]:
+        argv = [
+            sys.executable, "-m", "rt1_tpu.serve",
+            "--config", args.config,
+            "--port", "0",
+            "--replica_id", str(replica_id),
+            "--max_sessions", str(args.max_sessions),
+            "--embedder", args.embedder,
+        ]
+        if args.random_init:
+            argv.append("--random_init")
+        else:
+            argv.extend(["--workdir", args.workdir])
+        return argv
+    return build
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8400,
+                        help="Router bind port (0 = ephemeral).")
+    parser.add_argument("--config", default="",
+                        help="Model/data config path, forwarded to replicas.")
+    parser.add_argument("--workdir", default="",
+                        help="Checkpoint dir, forwarded to replicas "
+                             "(enables /reload from disk).")
+    parser.add_argument("--random_init", action="store_true")
+    parser.add_argument("--stub", action="store_true",
+                        help="Spawn model-free stub replicas "
+                             "(rt1_tpu.serve.stub) — protocol-true, no jax.")
+    parser.add_argument("--max_sessions", type=int, default=8)
+    parser.add_argument("--embedder", default="hash")
+    parser.add_argument("--stub_act_delay_s", type=float, default=0.0)
+    parser.add_argument("--faults", default="",
+                        help="Chaos plan, e.g. 'replica_kill@1,"
+                             "serve_reload@2' (RT1_FAULTS appended).")
+    parser.add_argument("--chaos_interval_s", type=float, default=2.0)
+    parser.add_argument("--poll_interval_s", type=float, default=0.25)
+    parser.add_argument("--replica_timeout_s", type=float, default=30.0)
+    parser.add_argument("--max_failovers", type=int, default=2)
+    parser.add_argument("--warmup_timeout_s", type=float, default=600.0)
+    parser.add_argument("--log_dir", default="",
+                        help="Per-replica stderr logs (default: inherit).")
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    if not args.stub and not args.config:
+        parser.error("--config is required unless --stub")
+    if not args.stub and not args.random_init and not args.workdir:
+        parser.error("pass --workdir (checkpoint) or --random_init")
+
+    faults.install_from(args.faults)
+
+    router = Router(
+        replica_timeout_s=args.replica_timeout_s,
+        max_failovers=args.max_failovers,
+    )
+    supervisor = FleetSupervisor(
+        router,
+        replica_argv_builder(args),
+        args.replicas,
+        chaos_interval_s=args.chaos_interval_s,
+        poll_interval_s=args.poll_interval_s,
+        warmup_timeout_s=args.warmup_timeout_s,
+        log_dir=args.log_dir or None,
+    )
+    supervisor.start(wait_ready=True)
+    httpd = make_router_server(
+        router, host=args.host, port=args.port, quiet=not args.verbose
+    )
+
+    stop_once = threading.Event()
+
+    def _shutdown(signum, frame):  # noqa: ARG001 - signal signature
+        if stop_once.is_set():
+            return
+        stop_once.set()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+
+    print(
+        json.dumps(
+            {
+                "status": "serving",
+                "role": "router",
+                "host": httpd.server_address[0],
+                "port": httpd.server_address[1],
+                "replicas": args.replicas,
+                "stub": bool(args.stub),
+                "faults": args.faults or os.environ.get(faults.ENV_VAR, ""),
+            }
+        ),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    finally:
+        httpd.server_close()
+        router.draining = True
+        final = {
+            "status": "stopped",
+            "fleet": router.fleet_status(probe_metrics=True),
+            "chaos": supervisor.summary(),
+            "router_metrics": router.metrics_snapshot(),
+        }
+        supervisor.stop()
+        print(json.dumps(final), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,6 +81,9 @@ class ServeMetrics:
         self.errors_total = 0
         self.rejected_total = 0
         self.resets_total = 0
+        self.reloads_total = 0            # checkpoint hot-swaps served
+        self.sessions_restarted_total = 0  # sessions re-homed after a
+        #                                    replica death (router-side)
         self.batches_total = 0
         self.occupancy_sum = 0
         self.occupancy_max = 0
@@ -104,6 +107,16 @@ class ServeMetrics:
     def observe_reset(self) -> None:
         with self._lock:
             self.resets_total += 1
+
+    def observe_reload(self) -> None:
+        """One successful zero-downtime checkpoint hot-swap."""
+        with self._lock:
+            self.reloads_total += 1
+
+    def observe_session_restart(self) -> None:
+        """One session re-homed (and reset) after its replica died."""
+        with self._lock:
+            self.sessions_restarted_total += 1
 
     def observe_batch(self, size: int, queued: int = 0) -> None:
         with self._lock:
@@ -163,6 +176,8 @@ class ServeMetrics:
                 "errors_total": self.errors_total,
                 "rejected_total": self.rejected_total,
                 "resets_total": self.resets_total,
+                "reloads_total": self.reloads_total,
+                "sessions_restarted_total": self.sessions_restarted_total,
                 "requests_per_sec": (
                     self.requests_total / uptime if uptime > 0 else 0.0
                 ),

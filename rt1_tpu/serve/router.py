@@ -1,0 +1,570 @@
+"""Session-affine router for a fleet of serving replicas.
+
+A single serving process (`python -m rt1_tpu.serve`) holds one AOT-compiled
+device batch; production traffic needs N of them. The catch is that a
+session is not stateless: its rolling `network_state` (context image
+tokens, action tokens, seq_idx) lives in a device slot on exactly ONE
+replica (`serve/engine.py`), so a round-robin balancer would scatter a
+session's observations across engines and corrupt every window. This
+router keeps the affinity map — session id -> replica — and layers the
+fleet behaviors on top:
+
+* **Health-aware placement.** New sessions land on the READY replica with
+  the fewest live sessions. Readiness comes from each replica's `/readyz`
+  (warming / draining / reloading all report 503): a replica still paying
+  XLA startup or mid-hot-swap keeps serving its existing sessions but
+  receives no new ones.
+* **Bounded failover, surfaced honestly.** A transport-dead replica
+  (connection refused/reset, timeout) fails the request over to a live
+  one — the session's rolling window is gone with the dead engine, so the
+  re-homed `/act` starts a fresh window (the engine zeroes the slot) and
+  the response carries ``"restarted": true``. The client sees a context
+  reset it can react to, never a 5xx. Every other session homed on the
+  dead replica is marked orphaned and picks up the same flag on its next
+  act. Failover is bounded (`max_failovers`); past it the router sheds
+  with a retryable 503.
+* **Rolling checkpoint reload.** `POST /reload` walks the fleet one
+  replica at a time: hot-swap (`serve/server.py` `/reload` — zero-downtime
+  in-place), then wait for `/readyz` to report ready again before touching
+  the next replica. At most one replica is ever in the not-ready drain
+  state, so fleet capacity never dips by more than one engine.
+
+The router carries no model code — stdlib HTTP + `ServeMetrics` only — so
+it stays featherweight next to N jax-heavy replicas (pinned by
+`tests/test_obs_imports.py`). Process supervision (spawn, restart,
+chaos) lives in `serve/fleet.py`; the router only reads the replica table
+the supervisor maintains.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from rt1_tpu.obs import prometheus as obs_prometheus
+from rt1_tpu.serve.metrics import ServeMetrics
+
+# Replica lifecycle as the router sees it. STARTING covers spawn ->
+# ready-line -> first /readyz 200 (warm-up gating: never placed on);
+# NOTREADY is a live replica whose /readyz says 503 (draining/reloading);
+# DEAD is transport-dead or process-exited, awaiting supervisor respawn.
+STARTING = "starting"
+READY = "ready"
+NOTREADY = "notready"
+DEAD = "dead"
+
+
+def post_json(
+    url: str, payload: Dict[str, Any], timeout: float
+) -> Tuple[int, Dict[str, Any]]:
+    """POST JSON -> (status, body); status 0 = transport failure (the
+    failover trigger: refused, reset, timeout, or a non-JSON corpse)."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.loads(exc.read())
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            return exc.code, {"error": str(exc)}
+    except Exception as exc:  # noqa: BLE001 - URLError/OSError/timeout/JSON
+        return 0, {"error": str(exc)}
+
+
+def get_json(url: str, timeout: float) -> Tuple[int, Dict[str, Any]]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.loads(exc.read())
+        except Exception:  # noqa: BLE001
+            return exc.code, {"error": str(exc)}
+    except Exception as exc:  # noqa: BLE001
+        return 0, {"error": str(exc)}
+
+
+class Replica:
+    """One serving process as the router tracks it (supervisor-owned
+    fields — proc, restarts — are written by serve/fleet.py)."""
+
+    def __init__(self, replica_id: int, url: Optional[str] = None, proc=None):
+        self.id = replica_id
+        self.url = url  # base http://host:port, known once the ready-line
+        #                 is read from the replica's stdout
+        self.proc = proc
+        self.state = STARTING
+        self.restarts = 0  # times the supervisor respawned this slot
+        self.consecutive_probe_failures = 0
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "id": self.id,
+            "url": self.url,
+            "state": self.state,
+            "restarts": self.restarts,
+        }
+
+
+class Router:
+    """Session-affinity routing table + failover + rolling reload."""
+
+    def __init__(
+        self,
+        *,
+        replica_timeout_s: float = 30.0,
+        max_failovers: int = 2,
+        reload_timeout_s: float = 300.0,
+        max_tracked_sessions: int = 8192,
+        metrics: Optional[ServeMetrics] = None,
+    ):
+        self._lock = threading.RLock()
+        self._replicas: Dict[int, Replica] = {}
+        # session id -> replica id, LRU-ordered and bounded: replicas cap
+        # their own live state at max_sessions slots, so a router tracking
+        # every id ever seen would leak memory and count long-evicted
+        # sessions into "least-loaded" placement. Oldest entries fall off
+        # past `max_tracked_sessions` (an evicted session that returns is
+        # simply re-placed, same as after a replica-side LRU reclaim).
+        self._sessions: collections.OrderedDict = collections.OrderedDict()
+        self.max_tracked_sessions = max_tracked_sessions
+        # Sessions whose replica died: their next successful act carries
+        # "restarted": true so the client learns its context was reset.
+        self._orphaned: set = set()
+        self.replica_timeout_s = replica_timeout_s
+        self.max_failovers = max_failovers
+        self.reload_timeout_s = reload_timeout_s
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.draining = False
+
+    # ------------------------------------------------------------ registry
+
+    def add_replica(self, replica: Replica) -> Replica:
+        with self._lock:
+            self._replicas[replica.id] = replica
+        return replica
+
+    def replicas(self) -> List[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def set_state(self, replica_id: int, state: str) -> None:
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return
+            replica.state = state
+            if state == DEAD:
+                self._orphan_sessions_locked(replica_id)
+
+    def _orphan_sessions_locked(self, replica_id: int) -> None:
+        lost = [s for s, r in self._sessions.items() if r == replica_id]
+        for sid in lost:
+            del self._sessions[sid]
+            self._orphaned.add(sid)
+        # Bound the orphan set too: a client that dies with its replica
+        # never comes back to consume its restarted flag, and repeated
+        # replica churn would otherwise grow this forever.
+        while len(self._orphaned) > self.max_tracked_sessions:
+            self._orphaned.pop()
+
+    def mark_dead(self, replica: Replica, reason: str = "") -> None:
+        """Replica is gone: orphan its sessions so their next act re-homes
+        (and reports restarted). Supervisor respawn flips it back later."""
+        del reason  # kept for call-site readability / future logging
+        self.set_state(replica.id, DEAD)
+
+    def _orphan_session(self, session_id: str, replica_id: int) -> None:
+        """Re-home ONE session (replica slow or mid-respawn): unmap it and
+        flag the restart, leaving its neighbors' state intact."""
+        with self._lock:
+            if self._sessions.get(session_id) == replica_id:
+                del self._sessions[session_id]
+            self._orphaned.add(session_id)
+
+    # ----------------------------------------------------------- placement
+
+    def session_count(self, replica_id: int) -> int:
+        with self._lock:
+            return sum(1 for r in self._sessions.values() if r == replica_id)
+
+    def _place_locked(self, session_id: str) -> Optional[Replica]:
+        ready = [r for r in self._replicas.values() if r.state == READY]
+        if not ready:
+            return None
+        loads = {rid: 0 for rid in self._replicas}
+        for rid in self._sessions.values():
+            loads[rid] = loads.get(rid, 0) + 1
+        best = min(ready, key=lambda r: (loads.get(r.id, 0), r.id))
+        self._sessions[session_id] = best.id
+        self._sessions.move_to_end(session_id)
+        while len(self._sessions) > self.max_tracked_sessions:
+            stale, _ = self._sessions.popitem(last=False)
+            self._orphaned.discard(stale)
+        return best
+
+    def _replica_for(self, session_id: str) -> Optional[Replica]:
+        """Existing assignment if its replica is still routable, else a
+        fresh placement on the least-loaded ready replica (None when the
+        fleet has no ready replica)."""
+        with self._lock:
+            rid = self._sessions.get(session_id)
+            if rid is not None:
+                replica = self._replicas.get(rid)
+                # Affinity overrides readiness for NOTREADY (draining/
+                # reloading replicas keep serving existing sessions);
+                # only DEAD forces a re-placement.
+                if replica is not None and replica.state != DEAD:
+                    self._sessions.move_to_end(session_id)  # LRU touch
+                    return replica
+                del self._sessions[session_id]
+                self._orphaned.add(session_id)
+            return self._place_locked(session_id)
+
+    # ------------------------------------------------------------- routing
+
+    def route_act(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Forward one /act with affinity + bounded failover. A replica
+        death mid-request becomes `restarted: true` on the retried 200,
+        never a client-visible 5xx."""
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            return 400, {"error": "'session_id' must be a non-empty string"}
+        if self.draining:
+            return 503, {"error": "draining"}
+        last_error = "no ready replicas"
+        for _ in range(self.max_failovers + 1):
+            replica = self._replica_for(session_id)
+            if replica is None:
+                return 503, {
+                    "error": "no ready replicas",
+                    "retry": True,
+                }
+            # Snapshot the url: the supervisor may respawn this replica
+            # (resetting url to None) between our request and the probe.
+            target_url = replica.url
+            if target_url is None:
+                self._orphan_session(session_id, replica.id)
+                continue
+            status, body = post_json(
+                target_url + "/act", payload, self.replica_timeout_s
+            )
+            if status == 0:
+                # Transport failure. Dead and merely-slow look identical
+                # from one request (a timeout is also status 0), but the
+                # blast radius differs: probe /readyz once to tell them
+                # apart before orphaning EVERY session homed there.
+                last_error = body.get("error", "transport failure")
+                probe, _ = get_json(target_url + "/readyz", timeout=2.0)
+                if probe == 0:
+                    # Probe dead too: the replica is gone (or wedged —
+                    # the supervisor's hang detector will kill it).
+                    self.mark_dead(replica, reason=last_error)
+                else:
+                    # Alive but slow for THIS request: re-home only this
+                    # session (its window may have advanced server-side —
+                    # honesty demands the restarted flag either way) and
+                    # leave its neighbors' state intact.
+                    self._orphan_session(session_id, replica.id)
+                continue
+            if status == 200:
+                with self._lock:
+                    if session_id in self._orphaned:
+                        self._orphaned.discard(session_id)
+                        body["restarted"] = True
+                        self.metrics.observe_session_restart()
+            return status, body
+        return 503, {
+            "error": f"failover budget exhausted: {last_error}",
+            "retry": True,
+        }
+
+    def route_session_op(
+        self, path: str, payload: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """/reset places (a reset starts a fresh window anywhere);
+        /release forwards to the owner and always clears the local map."""
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            return 400, {"error": "'session_id' must be a non-empty string"}
+        if path == "/release":
+            with self._lock:
+                rid = self._sessions.pop(session_id, None)
+                was_orphaned = session_id in self._orphaned
+                self._orphaned.discard(session_id)
+                replica = self._replicas.get(rid) if rid is not None else None
+            if replica is None or replica.state == DEAD:
+                # Never-seen is a client error; a session whose replica
+                # died (orphaned, or mapped to a dead/gone replica) has no
+                # server-side slot left to free — that release is a
+                # successful no-op, not a 404.
+                if rid is None and not was_orphaned:
+                    return 404, {"error": f"unknown session {session_id!r}"}
+                return 200, {"ok": True, "note": "replica was dead"}
+            return post_json(
+                replica.url + path, payload, self.replica_timeout_s
+            )
+        replica = self._replica_for(session_id)
+        if replica is None:
+            return 503, {"error": "no ready replicas", "retry": True}
+        status, body = post_json(
+            replica.url + path, payload, self.replica_timeout_s
+        )
+        if status == 0:
+            self.mark_dead(replica, reason=body.get("error", ""))
+            return 503, {"error": "replica died during reset", "retry": True}
+        if status == 200:
+            with self._lock:
+                self._orphaned.discard(session_id)  # an explicit reset is
+                #   a client-acknowledged fresh window, not a restart
+        return status, body
+
+    # ------------------------------------------------------------- reload
+
+    def rolling_reload(
+        self, step: Optional[int] = None
+    ) -> List[Dict[str, Any]]:
+        """Hot-swap a checkpoint across the fleet one replica at a time.
+
+        Each replica's own `/reload` is already zero-downtime; the rolling
+        walk bounds fleet impact: wait for `/readyz` to recover before
+        moving on, so at most one replica is in the reloading drain state
+        at any moment. A replica that fails to reload is recorded and the
+        roll continues — a bad checkpoint rejected by `swap_variables`
+        leaves every replica serving the old params.
+        """
+        results = []
+        for replica in sorted(self.replicas(), key=lambda r: r.id):
+            if replica.state == DEAD or replica.url is None:
+                results.append(
+                    {"replica": replica.id, "skipped": replica.state}
+                )
+                continue
+            payload = {} if step is None else {"step": step}
+            status, body = post_json(
+                replica.url + "/reload", payload, self.reload_timeout_s
+            )
+            entry = {"replica": replica.id, "status": status, **body}
+            if status == 0:
+                self.mark_dead(replica, reason=body.get("error", ""))
+            elif status == 200:
+                # A swap that lands but never returns to ready degraded
+                # the fleet — surface it, don't report a clean roll.
+                entry["recovered"] = self._await_ready(replica)
+                if not entry["recovered"]:
+                    entry["ok"] = False
+            results.append(entry)
+        if any(r.get("status") == 200 for r in results):
+            self.metrics.observe_reload()  # one counted roll, however driven
+        return results
+
+    def _await_ready(self, replica: Replica, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status, _ = get_json(replica.url + "/readyz", timeout=5.0)
+            if status == 200:
+                self.set_state(replica.id, READY)
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -------------------------------------------------------------- status
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._replicas.values() if r.state == READY
+            )
+
+    def _gauges(self) -> Dict[str, Any]:
+        with self._lock:
+            states: Dict[str, int] = {}
+            for replica in self._replicas.values():
+                states[replica.state] = states.get(replica.state, 0) + 1
+            return {
+                "replicas_total": len(self._replicas),
+                "replicas_ready": states.get(READY, 0),
+                "replicas_dead": states.get(DEAD, 0),
+                "sessions_total": len(self._sessions),
+                "sessions_orphaned": len(self._orphaned),
+                "replica_restarts_total": sum(
+                    r.restarts for r in self._replicas.values()
+                ),
+                "draining": int(self.draining),
+                "ready": int(states.get(READY, 0) > 0),
+            }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.metrics.snapshot(**self._gauges())
+
+    def metrics_prometheus(self) -> str:
+        return self.metrics.prometheus_text(**self._gauges())
+
+    def fleet_status(self, probe_metrics: bool = True) -> Dict[str, Any]:
+        """Per-replica table for /fleet/status; with `probe_metrics`, each
+        live replica's own /metrics is sampled for the single-compile and
+        reload evidence the chaos bench asserts on."""
+        replicas = []
+        for replica in sorted(self.replicas(), key=lambda r: r.id):
+            entry = replica.summary()
+            entry["sessions"] = self.session_count(replica.id)
+            if probe_metrics and replica.url and replica.state != DEAD:
+                status, body = get_json(replica.url + "/metrics", timeout=5.0)
+                if status == 200:
+                    entry["metrics"] = {
+                        k: body.get(k)
+                        for k in (
+                            "compile_count",
+                            "reloads_total",
+                            "requests_total",
+                            "active_sessions",
+                            "uptime_s",
+                        )
+                    }
+            replicas.append(entry)
+        return {"replicas": replicas, **self._gauges()}
+
+    def healthz(self) -> Dict[str, Any]:
+        """Router liveness + the serving contract proxied from a ready
+        replica (clients read image_shape from here, same as single-node)."""
+        out: Dict[str, Any] = {
+            "status": "draining" if self.draining else "ok",
+            "role": "router",
+            **self._gauges(),
+        }
+        for replica in self.replicas():
+            if replica.state == READY and replica.url:
+                status, body = get_json(
+                    replica.url + "/healthz", timeout=5.0
+                )
+                if status == 200:
+                    for key in ("image_shape", "embed_dim", "max_sessions"):
+                        if key in body:
+                            out[key] = body[key]
+                    break
+        return out
+
+    def readyz(self) -> Tuple[int, Dict[str, Any]]:
+        if self.draining:
+            return 503, {"ready": False, "reason": "draining"}
+        ready = self.ready_count()
+        if ready == 0:
+            return 503, {"ready": False, "reason": "no ready replicas"}
+        return 200, {"ready": True, "replicas_ready": ready}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: Router = None  # bound by make_router_server
+    quiet: bool = True
+
+    def log_message(self, fmt, *args):  # noqa: D102 - stdlib hook
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        if self.path == "/healthz":
+            self._reply(200, self.router.healthz())
+        elif self.path == "/readyz":
+            code, payload = self.router.readyz()
+            self._reply(code, payload)
+        elif self.path == "/fleet/status":
+            self._reply(200, self.router.fleet_status())
+        elif self.path == "/metrics":
+            if obs_prometheus.accepts_text(self.headers.get("Accept")):
+                self._reply_text(
+                    200,
+                    self.router.metrics_prometheus(),
+                    obs_prometheus.CONTENT_TYPE,
+                )
+            else:
+                self._reply(200, self.router.metrics_snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib casing
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length)) if length else {}
+        except json.JSONDecodeError as exc:
+            self._reply(400, {"error": f"invalid JSON body: {exc}"})
+            return
+        if not isinstance(payload, dict):
+            self._reply(400, {"error": "request body must be a JSON object"})
+            return
+        t0 = time.perf_counter()
+        if self.path == "/act":
+            status, body = self.router.route_act(payload)
+            if status == 503:
+                # Shed load (no ready replicas / failover budget) is the
+                # rejected counter, not errors_total — same split the
+                # single-replica server makes for its busy 503s.
+                self.router.metrics.observe_rejected()
+            else:
+                self.router.metrics.observe_request(
+                    time.perf_counter() - t0, ok=status == 200
+                )
+            self._reply(status, body)
+        elif self.path in ("/reset", "/release"):
+            status, body = self.router.route_session_op(self.path, payload)
+            if self.path == "/reset" and status == 200:
+                self.router.metrics.observe_reset()
+            self._reply(status, body)
+        elif self.path == "/reload":
+            results = self.router.rolling_reload(payload.get("step"))
+            # A clean roll means every replica swapped AND recovered; a
+            # skipped (dead/respawning) replica is a partial roll — the
+            # fleet may be serving mixed checkpoint versions — and must
+            # not be reported as ok.
+            failed = [
+                r
+                for r in results
+                if r.get("status") != 200 or r.get("recovered") is False
+            ]
+            self._reply(
+                200 if not failed else 502,
+                {"ok": not failed, "replicas": results},
+            )
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+
+def make_router_server(
+    router: Router, host: str = "127.0.0.1", port: int = 0, quiet: bool = True
+) -> ThreadingHTTPServer:
+    """Bind a ThreadingHTTPServer to `router` (port 0 = ephemeral)."""
+    handler = type(
+        "BoundRouterHandler", (_RouterHandler,),
+        {"router": router, "quiet": quiet},
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd

@@ -13,6 +13,10 @@ Endpoints (all JSON):
                   "embedding"} -> {"action", "action_tokens", ...}
 * `POST /reset`  {"session_id"} -> {"ok": true, "slot": i}
 * `POST /release` {"session_id"} -> {"ok": true}
+* `POST /reload`  {"step"?} -> zero-downtime checkpoint hot-swap: restore
+                  into a standby buffer, validate, atomically swap device
+                  params with no recompile and no dropped requests; 409
+                  while another reload runs, `/readyz` says `reloading`.
 * `GET /healthz` liveness + model/input contract (clients read the
                   expected image shape from here). Always 200 while the
                   process serves HTTP — restart-deciders watch this.
@@ -57,6 +61,10 @@ from rt1_tpu.serve.metrics import ServeMetrics
 
 class RequestError(ValueError):
     """Malformed client payload -> HTTP 400."""
+
+
+class ReloadInProgressError(RuntimeError):
+    """A checkpoint hot-swap is already running -> HTTP 409."""
 
 
 def parse_observation(
@@ -123,13 +131,27 @@ class ServeApp:
         max_queue: int = 64,
         request_timeout_s: float = 60.0,
         metrics: Optional[ServeMetrics] = None,
+        replica_id: int = 0,
+        reload_fn=None,
     ):
         self.engine = engine
         self.image_shape = tuple(image_shape)
         self.embed_dim = embed_dim
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.request_timeout_s = request_timeout_s
+        self.replica_id = replica_id
+        # reload_fn(step|None) -> (variables, checkpoint_step): the standby
+        # restore path behind POST /reload (eval/restore.py
+        # load_standby_variables closed over config+workdir).
+        self._reload_fn = reload_fn
+        self._reload_lock = threading.Lock()
+        self.reloading = False
         self.draining = False
+        # Guards the draining-check + batcher-submit pair in act() against
+        # drain(): a request that passed the check is guaranteed to be
+        # scheduled on the loop BEFORE batcher.drain() is, so FIFO loop
+        # ordering flushes it instead of 503ing an admitted request.
+        self._admit_lock = threading.Lock()
         # Flipped by start() once the batcher runs and the AOT warmup
         # compile finished — /readyz gates on it.
         self.ready = False
@@ -171,9 +193,15 @@ class ServeApp:
 
     def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
         """Blocking bridge used by HTTP handler threads."""
-        future = asyncio.run_coroutine_threadsafe(
-            self.batcher.submit((session_id, obs)), self._loop
-        )
+        with self._admit_lock:
+            # Atomic with drain()'s flag flip: once a request passes this
+            # check it is scheduled on the loop ahead of batcher.drain(),
+            # so SIGTERM flushes it — admitted work is never answered 503.
+            if self.draining:
+                raise DrainingError("draining; not accepting requests")
+            future = asyncio.run_coroutine_threadsafe(
+                self.batcher.submit((session_id, obs)), self._loop
+            )
         try:
             result = future.result(timeout=self.request_timeout_s)
         except concurrent.futures.TimeoutError:
@@ -189,9 +217,17 @@ class ServeApp:
         return result
 
     def drain(self, timeout: float = 30.0) -> None:
-        """Graceful shutdown: reject new work, flush everything admitted."""
-        self.draining = True
-        self.ready = False  # /readyz flips 503 the moment draining starts
+        """Graceful shutdown: reject new work, flush everything admitted.
+
+        The `_admit_lock` handshake closes the drain/in-flight race: any
+        act() that saw `draining == False` has already scheduled its submit
+        coroutine, and the loop runs callbacks FIFO — `batcher.drain()` is
+        scheduled after it, so the batcher only starts refusing once every
+        admitted request sits in its pending queue, where drain flushes it.
+        """
+        with self._admit_lock:
+            self.draining = True
+            self.ready = False  # /readyz flips 503 as draining starts
         if self._loop_thread.is_alive():
             asyncio.run_coroutine_threadsafe(
                 self.batcher.drain(), self._loop
@@ -199,21 +235,63 @@ class ServeApp:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=timeout)
 
+    def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """Zero-downtime checkpoint hot-swap: restore into a standby host
+        buffer via `reload_fn`, validate, atomically swap into the engine.
+
+        Serving continues throughout — in-flight and concurrent requests
+        run on the old params until the swap lands between two batches.
+        `/readyz` reports 503 `reloading` for the duration so a router
+        pauses NEW session placement (rolling-reload drain semantics)
+        while existing sessions keep flowing. One reload at a time
+        (`ReloadInProgressError` -> 409).
+        """
+        if self._reload_fn is None:
+            raise RequestError(
+                "this replica has no reload source: started without a "
+                "checkpoint workdir (pass reload_fn= to ServeApp)"
+            )
+        if not self._reload_lock.acquire(blocking=False):
+            raise ReloadInProgressError(
+                "a checkpoint reload is already in progress"
+            )
+        try:
+            self.reloading = True
+            variables, restored_step = self._reload_fn(step)
+            info = self.engine.swap_variables(variables)
+            self.metrics.observe_reload()
+            return {
+                "ok": True,
+                "checkpoint_step": restored_step,
+                "reloads_total": self.engine.reloads,
+                **info,
+            }
+        finally:
+            self.reloading = False
+            self._reload_lock.release()
+
     def healthz(self) -> Dict[str, Any]:
         return {
             "status": "draining" if self.draining else "ok",
+            "replica_id": self.replica_id,
             "image_shape": list(self.image_shape),
             "embed_dim": self.embed_dim,
             "max_sessions": self.engine.max_sessions,
             "active_sessions": self.engine.active_sessions,
             "compile_count": self.engine.compile_count,
+            "reloads": self.engine.reloads,
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
         """(http_code, payload) for the readiness probe: 503 unless the
-        first AOT compile finished AND no drain is in progress."""
+        first AOT compile finished AND no drain/reload is in progress.
+        `reloading` is a soft not-ready: the replica still serves /act
+        (existing sessions keep flowing through a session-affine router),
+        but new placement should wait out the swap."""
         if self.draining:
             return 503, {"ready": False, "reason": "draining"}
+        if self.reloading:
+            return 503, {"ready": False, "reason": "reloading"}
         if not self.ready:
             return 503, {"ready": False, "reason": "warming"}
         return 200, {"ready": True}
@@ -230,6 +308,8 @@ class ServeApp:
             # shutdown even if their LB already stopped routing /readyz).
             "draining": int(self.draining),
             "ready": int(self.ready),
+            "reloading": int(self.reloading),
+            "replica_id": self.replica_id,
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -313,8 +393,26 @@ class _Handler(BaseHTTPRequestHandler):
                              count_reset=True)
         elif self.path == "/release":
             self._session_op(payload, self.app.engine.release, None)
+        elif self.path == "/reload":
+            self._reload(payload)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def _reload(self, payload):
+        step = payload.get("step")
+        if step is not None and not isinstance(step, int):
+            self._reply(400, {"error": "'step' must be an integer"})
+            return
+        try:
+            self._reply(200, self.app.reload(step))
+        except RequestError as exc:
+            self._reply(400, {"error": str(exc)})
+        except ReloadInProgressError as exc:
+            self._reply(409, {"error": str(exc), "retry": True})
+        except Exception as exc:  # noqa: BLE001 - restore/validate failure
+            # Old params are still serving (swap_variables rejects without
+            # touching the engine) — report, don't crash the replica.
+            self._reply(500, {"error": f"reload failed: {exc}"})
 
     def _session_id(self, payload) -> str:
         session_id = payload.get("session_id")

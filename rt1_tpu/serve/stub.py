@@ -1,0 +1,302 @@
+"""Stub serving replica: the replica HTTP contract with no model behind it.
+
+`python -m rt1_tpu.serve.stub` speaks exactly the protocol a real replica
+(`python -m rt1_tpu.serve`) speaks — the JSON ready-line on stdout, then
+`/act /reset /release /reload /healthz /readyz /metrics` — but its "engine"
+is a dict of per-session step counters and its "checkpoint reload" is a
+sleep. That makes it the router/fleet test double: `serve/fleet.py` spawns
+it with `--stub`, and the tier-1 fleet tests (spawn, kill, re-home,
+rolling reload) run in seconds instead of paying a jax import plus an XLA
+compile per replica. Chaos rehearsal against a laptop with no accelerator
+uses the same path.
+
+Deliberately model-free and jax-free (stdlib + the shared `ServeMetrics`):
+the stub must stay cheap enough that killing and respawning it in a loop
+is free, and it doubles as the executable specification of the replica
+protocol — if a field moves in `serve/server.py`, the fleet tests against
+the stub catch the drift.
+
+Actions are deterministic in (session, step): ``action[i] = ((step * 7 + i)
+% 13 - 6) / 300`` — enough structure for a test to assert that a re-homed
+session restarted from step 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Tuple
+
+from rt1_tpu.obs import prometheus as obs_prometheus
+from rt1_tpu.serve.metrics import ServeMetrics
+
+IMAGE_SHAPE = (8, 14, 3)  # tiny but nonzero: loadgen reads this contract
+EMBED_DIM = 16
+
+
+def stub_action(step: int, dims: int = 2):
+    return [((step * 7 + i) % 13 - 6) / 300.0 for i in range(dims)]
+
+
+class StubReplicaApp:
+    """Session counters + lifecycle flags behind the replica contract."""
+
+    def __init__(
+        self,
+        replica_id: int = 0,
+        max_sessions: int = 8,
+        act_delay_s: float = 0.0,
+        reload_delay_s: float = 0.05,
+    ):
+        self.replica_id = replica_id
+        self.max_sessions = max_sessions
+        self.act_delay_s = act_delay_s
+        self.reload_delay_s = reload_delay_s
+        self.metrics = ServeMetrics()
+        self.ready = True
+        self.draining = False
+        self.reloading = False
+        self.reloads = 0
+        self.checkpoint_step = -1
+        self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()  # one reload at a time (409)
+        self._sessions: Dict[str, int] = {}  # session -> next step index
+
+    # ------------------------------------------------------------- handlers
+
+    def act(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            return 400, {"error": "'session_id' must be a non-empty string"}
+        if "image" not in payload and "image_b64" not in payload:
+            return 400, {"error": "payload needs 'image' or 'image_b64'"}
+        if self.draining:
+            return 503, {"error": "draining"}
+        t0 = time.perf_counter()
+        if self.act_delay_s:
+            time.sleep(self.act_delay_s)  # inside the timer: the stub's
+            #   latency histogram must reflect the simulated step cost
+        with self._lock:
+            started = session_id not in self._sessions
+            step = self._sessions.get(session_id, 0)
+            self._sessions[session_id] = step + 1
+        self.metrics.observe_request(time.perf_counter() - t0)
+        self.metrics.observe_batch(1, queued=0)
+        return 200, {
+            "action": stub_action(step),
+            "action_tokens": [0, step % 256, (step * 3) % 256],
+            "session_started": started,
+            # Test hook: which process+step actually served this act.
+            "replica_id": self.replica_id,
+            "step_index": step,
+        }
+
+    def reset(self, payload) -> Tuple[int, Dict[str, Any]]:
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            return 400, {"error": "'session_id' must be a non-empty string"}
+        with self._lock:
+            self._sessions[session_id] = 0
+            slot = list(self._sessions).index(session_id)
+        self.metrics.observe_reset()
+        return 200, {"ok": True, "slot": slot}
+
+    def release(self, payload) -> Tuple[int, Dict[str, Any]]:
+        session_id = payload.get("session_id")
+        with self._lock:
+            known = self._sessions.pop(session_id, None)
+        if known is None:
+            return 404, {"error": f"unknown session {session_id!r}"}
+        return 200, {"ok": True}
+
+    def reload(self, payload) -> Tuple[int, Dict[str, Any]]:
+        # Same one-reload-at-a-time contract as ServeApp._reload_lock —
+        # handlers run concurrently, a bare flag check would race.
+        if not self._reload_lock.acquire(blocking=False):
+            return 409, {"error": "a reload is already in progress",
+                         "retry": True}
+        self.reloading = True
+        try:
+            time.sleep(self.reload_delay_s)  # the restore-and-validate cost
+            self.reloads += 1
+            self.checkpoint_step = payload.get("step", -1)
+            self.metrics.observe_reload()
+            return 200, {
+                "ok": True,
+                "checkpoint_step": self.checkpoint_step,
+                "reloads_total": self.reloads,
+                "params_swapped": 0,
+            }
+        finally:
+            self.reloading = False
+            self._reload_lock.release()
+
+    def healthz(self) -> Dict[str, Any]:
+        with self._lock:
+            active = len(self._sessions)
+        return {
+            "status": "draining" if self.draining else "ok",
+            "stub": True,
+            "replica_id": self.replica_id,
+            "image_shape": list(IMAGE_SHAPE),
+            "embed_dim": EMBED_DIM,
+            "max_sessions": self.max_sessions,
+            "active_sessions": active,
+            "compile_count": 1,  # the contract field; nothing compiles here
+            "reloads": self.reloads,
+        }
+
+    def readyz(self) -> Tuple[int, Dict[str, Any]]:
+        if self.draining:
+            return 503, {"ready": False, "reason": "draining"}
+        if self.reloading:
+            return 503, {"ready": False, "reason": "reloading"}
+        if not self.ready:
+            return 503, {"ready": False, "reason": "warming"}
+        return 200, {"ready": True}
+
+    def _gauges(self) -> Dict[str, Any]:
+        with self._lock:
+            active = len(self._sessions)
+        return {
+            "active_sessions": active,
+            "compile_count": 1,
+            "draining": int(self.draining),
+            "ready": int(self.ready),
+            "reloading": int(self.reloading),
+            "replica_id": self.replica_id,
+        }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        return self.metrics.snapshot(**self._gauges())
+
+    def metrics_prometheus(self) -> str:
+        return self.metrics.prometheus_text(**self._gauges())
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    app: StubReplicaApp = None
+
+    def log_message(self, fmt, *args):  # noqa: D102 - stdlib hook
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib casing
+        if self.path == "/healthz":
+            self._reply(200, self.app.healthz())
+        elif self.path == "/readyz":
+            code, payload = self.app.readyz()
+            self._reply(code, payload)
+        elif self.path == "/metrics":
+            if obs_prometheus.accepts_text(self.headers.get("Accept")):
+                text = self.app.metrics_prometheus().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", obs_prometheus.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(text)))
+                self.end_headers()
+                self.wfile.write(text)
+            else:
+                self._reply(200, self.app.metrics_snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib casing
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            payload = json.loads(self.rfile.read(length)) if length else {}
+        except json.JSONDecodeError as exc:
+            self._reply(400, {"error": f"invalid JSON body: {exc}"})
+            return
+        ops = {
+            "/act": self.app.act,
+            "/reset": self.app.reset,
+            "/release": self.app.release,
+            "/reload": self.app.reload,
+        }
+        op = ops.get(self.path)
+        if op is None:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        code, body = op(payload)
+        self._reply(code, body)
+
+
+def make_stub_server(
+    app: StubReplicaApp, host: str = "127.0.0.1", port: int = 0
+) -> ThreadingHTTPServer:
+    handler = type("BoundStubHandler", (_StubHandler,), {"app": app})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--replica_id", type=int, default=0)
+    parser.add_argument("--max_sessions", type=int, default=8)
+    parser.add_argument(
+        "--startup_delay_s", type=float, default=0.0,
+        help="Simulated warm-up: /readyz says 'warming' this long.")
+    parser.add_argument(
+        "--act_delay_s", type=float, default=0.0,
+        help="Simulated device-step latency per /act.")
+    parser.add_argument("--reload_delay_s", type=float, default=0.05)
+    args = parser.parse_args(argv)
+
+    app = StubReplicaApp(
+        replica_id=args.replica_id,
+        max_sessions=args.max_sessions,
+        act_delay_s=args.act_delay_s,
+        reload_delay_s=args.reload_delay_s,
+    )
+    httpd = make_stub_server(app, host=args.host, port=args.port)
+    if args.startup_delay_s:
+        app.ready = False
+
+        def _warm():
+            time.sleep(args.startup_delay_s)
+            app.ready = True
+
+        threading.Thread(target=_warm, daemon=True).start()
+    # The same ready-line contract as python -m rt1_tpu.serve: the fleet
+    # supervisor learns the ephemeral port from this one stdout line.
+    print(
+        json.dumps(
+            {
+                "status": "serving",
+                "stub": True,
+                "host": httpd.server_address[0],
+                "port": httpd.server_address[1],
+                "replica_id": args.replica_id,
+                "checkpoint_step": -1,
+                "max_sessions": args.max_sessions,
+                "compile_count": 1,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

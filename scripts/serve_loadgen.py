@@ -1,23 +1,47 @@
-"""Load generator for the `rt1_tpu.serve` inference service.
+"""Load generator for the `rt1_tpu.serve` inference service — single
+replica or a whole fleet.
 
-Drives N concurrent synthetic sessions against a running server and emits
-one BENCH-style JSON line (the `bench.py` headline convention: metric /
-value / unit plus supporting fields) so serving performance can be tracked
-across PRs alongside `BENCH_*.json`:
+Drives N concurrent synthetic sessions and emits one BENCH-style JSON line
+(the `bench.py` headline convention: metric / value / unit plus supporting
+fields) so serving performance can be tracked across PRs alongside
+`BENCH_*.json`:
 
-  # terminal 1
+  # single server (terminal 1 + 2):
   JAX_PLATFORMS=cpu python -m rt1_tpu.serve \
       --config rt1_tpu/train/configs/tiny.py --random_init --port 8321
-  # terminal 2
   python scripts/serve_loadgen.py --url http://127.0.0.1:8321 \
       --sessions 8 --steps 32
 
-Each session thread: /reset, then a closed loop of /act requests carrying a
+  # fleet + chaos, one command (spawns python -m rt1_tpu.serve.fleet,
+  # waits for all replicas ready, drives load THROUGH the router while
+  # the supervisor kills and reloads replicas on the fault schedule):
+  JAX_PLATFORMS=cpu python scripts/serve_loadgen.py --fleet 3 \
+      --config rt1_tpu/train/configs/tiny.py --random_init \
+      --faults "replica_kill@1,serve_reload@2" --duration 30 \
+      --output BENCH_serve_fleet.json
+
+Each session thread: /reset, then a loop of /act requests carrying a
 random uint8 frame (base64-packed) and an instruction drawn from a small
-pool (so the server's embedding cache sees realistic reuse). 503 busy
-responses are retried with a short backoff and counted — backpressure is a
-measured quantity here, not an error. The image shape is read from the
-server's /healthz contract unless given explicitly.
+pool. The loop is `--steps`-bounded or `--duration`-bounded (time-based,
+with jittered think-time arrivals — `--think_time` mean seconds between a
+session's requests — so a chaos window is sampled by a steady open-ish
+load rather than a start-line burst).
+
+Every request lands in exactly one outcome class, each with its own
+latency percentiles in the output:
+
+* ``ok``         — 200
+* ``restarted``  — 200 carrying ``"restarted": true``: the session's
+                   replica died and the router re-homed it (fresh context
+                   window). Bounded, honest degradation — not an error.
+* ``rejected``   — 503 after the retry budget (busy backpressure or a
+                   no-ready-replicas window): shed load, client-visible
+                   but clean.
+* ``failed``     — transport failure or any 4xx/5xx beyond the above; a
+                   fleet run's acceptance bar is ``requests_failed == 0``.
+
+503s with ``retry: true`` are retried with a short backoff and counted
+(`requests_busy_retried`) — backpressure is a measured quantity here.
 """
 
 from __future__ import annotations
@@ -25,6 +49,8 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -39,6 +65,8 @@ INSTRUCTION_POOL = (
     "slide the yellow pentagon towards the red moon",
     "separate the red moon from the blue cube",
 )
+
+OUTCOME_CLASSES = ("ok", "restarted", "rejected", "failed")
 
 
 def _post(url: str, payload: dict, timeout: float) -> tuple[int, dict]:
@@ -74,47 +102,72 @@ def _session_worker(
     url: str,
     session_id: str,
     steps: int,
+    duration_s: float,
+    think_time_s: float,
     image_shape: tuple,
     instruction: str,
     timeout: float,
+    max_retries: int,
     barrier: threading.Barrier,
     out: dict,
     rng: np.random.Generator,
 ):
-    latencies = []
-    busy = 0
-    errors = 0
-    # Record a result no matter how this thread exits, and never skip the
-    # barrier: a missing wait would deadlock every other session.
-    out[session_id] = {"latencies": latencies, "busy": 0, "errors": 0}
-    try:
-        status, _ = _post(url + "/reset", {"session_id": session_id}, timeout)
-        _barrier_wait(barrier, timeout)  # start all act loops together
-        if status != 200:
-            errors = steps  # reset failed; count the whole session as lost
-            return
-        for _ in range(steps):
-            frame = rng.integers(0, 256, size=image_shape, dtype=np.uint8)
-            payload = {
-                "session_id": session_id,
-                "image_b64": base64.b64encode(frame.tobytes()).decode("ascii"),
-                "instruction": instruction,
-            }
-            while True:
-                t0 = time.perf_counter()
-                status, body = _post(url + "/act", payload, timeout)
-                if status == 503 and body.get("retry"):
-                    busy += 1
-                    time.sleep(0.005)
-                    continue
+    # latencies[class] = [seconds]; record a result no matter how this
+    # thread exits, and never skip the barrier: a missing wait would
+    # deadlock every other session.
+    latencies = {k: [] for k in OUTCOME_CLASSES}
+    record = {"latencies": latencies, "busy": 0}
+    out[session_id] = record  # in place from the start: a dying thread
+    #                           still leaves a valid (partial) record
+    status, _ = _post(url + "/reset", {"session_id": session_id}, timeout)
+    _barrier_wait(barrier, timeout)  # start all act loops together
+    if status != 200:
+        # Reset failed; the whole session is lost — one failed marker
+        # (not a per-step fabrication, which would poison the failed-class
+        # percentiles and the duration-mode counts).
+        latencies["failed"].append(0.0)
+        return
+    deadline = time.perf_counter() + duration_s if duration_s > 0 else None
+    step = 0
+    while True:
+        if deadline is not None:
+            if time.perf_counter() >= deadline:
                 break
-            if status == 200 and "action" in body:
-                latencies.append(time.perf_counter() - t0)
-            else:
-                errors += 1
-    finally:
-        out[session_id]["busy"] = busy
-        out[session_id]["errors"] = errors
+        elif step >= steps:
+            break
+        step += 1
+        frame = rng.integers(0, 256, size=image_shape, dtype=np.uint8)
+        payload = {
+            "session_id": session_id,
+            "image_b64": base64.b64encode(frame.tobytes()).decode("ascii"),
+            "instruction": instruction,
+        }
+        retries = 0
+        t0 = time.perf_counter()
+        while True:
+            status, body = _post(url + "/act", payload, timeout)
+            if (
+                status == 503
+                and body.get("retry")
+                and retries < max_retries
+            ):
+                retries += 1
+                record["busy"] += 1
+                time.sleep(0.005)
+                continue
+            break
+        elapsed = time.perf_counter() - t0
+        if status == 200 and "action" in body:
+            klass = "restarted" if body.get("restarted") else "ok"
+        elif status == 503:
+            klass = "rejected"  # shed after the retry budget
+        else:
+            klass = "failed"  # transport death or unexpected 4xx/5xx
+        latencies[klass].append(elapsed)
+        if think_time_s > 0:
+            # Jittered arrivals: uniform on [0, 2*mean] keeps the mean
+            # think time while decorrelating sessions.
+            time.sleep(rng.uniform(0.0, 2.0 * think_time_s))
 
 
 def _barrier_wait(barrier: threading.Barrier, timeout: float) -> None:
@@ -124,15 +177,31 @@ def _barrier_wait(barrier: threading.Barrier, timeout: float) -> None:
         pass  # a sibling died/timed out; run unsynchronized rather than hang
 
 
+def _pct(sorted_latencies: list, q: float) -> float:
+    if not sorted_latencies:
+        return 0.0
+    index = min(int(q * len(sorted_latencies)), len(sorted_latencies) - 1)
+    return sorted_latencies[index]
+
+
 def run_loadgen(
     url: str,
     sessions: int = 8,
     steps: int = 32,
+    duration_s: float = 0.0,
+    think_time_s: float = 0.0,
     image_shape=None,
     timeout: float = 30.0,
+    max_retries: int = 400,
     seed: int = 0,
 ) -> dict:
-    """Run the synthetic load and return the BENCH-style result dict."""
+    """Run the synthetic load and return the BENCH-style result dict.
+
+    `duration_s > 0` switches from step-bounded to time-bounded sessions
+    (chaos runs want a fixed observation window, not a fixed request
+    count). Latency percentiles are reported overall AND per outcome
+    class, so "how slow was a restarted request" is a first-class number.
+    """
     url = url.rstrip("/")
     health = _get(url + "/healthz", timeout)
     if image_shape is None:
@@ -149,9 +218,12 @@ def run_loadgen(
                 url,
                 f"loadgen-{i}",
                 steps,
+                duration_s,
+                think_time_s,
                 image_shape,
                 INSTRUCTION_POOL[i % len(INSTRUCTION_POOL)],
                 timeout,
+                max_retries,
                 barrier,
                 out,
                 rng,
@@ -164,30 +236,42 @@ def run_loadgen(
         thread.join()
     wall = time.perf_counter() - t_start
 
-    latencies = sorted(
-        lat for result in out.values() for lat in result["latencies"]
-    )
+    by_class = {
+        klass: sorted(
+            lat
+            for result in out.values()
+            for lat in result["latencies"][klass]
+        )
+        for klass in OUTCOME_CLASSES
+    }
+    answered = sorted(by_class["ok"] + by_class["restarted"])
     busy = sum(result["busy"] for result in out.values())
-    errors = sum(result["errors"] for result in out.values())
     server_metrics = _get(url + "/metrics", timeout)
 
-    def pct(q: float) -> float:
-        if not latencies:
-            return 0.0
-        return latencies[min(int(q * len(latencies)), len(latencies) - 1)]
-
-    return {
+    result = {
         "metric": "serve_requests_per_sec",
-        "value": round(len(latencies) / wall, 3) if wall > 0 else 0.0,
+        "value": round(len(answered) / wall, 3) if wall > 0 else 0.0,
         "unit": "req/s",
         "sessions": sessions,
-        "steps_per_session": steps,
-        "requests_ok": len(latencies),
+        "steps_per_session": steps if duration_s <= 0 else None,
+        "duration_s": round(duration_s, 3) if duration_s > 0 else None,
+        "think_time_s": think_time_s,
+        "requests_ok": len(by_class["ok"]),
+        "requests_restarted": len(by_class["restarted"]),
+        "requests_rejected": len(by_class["rejected"]),
+        "requests_failed": len(by_class["failed"]),
         "requests_busy_retried": busy,
-        "requests_failed": errors,
         "wall_s": round(wall, 4),
-        "latency_p50_ms": round(pct(0.50) * 1e3, 3),
-        "latency_p99_ms": round(pct(0.99) * 1e3, 3),
+        "latency_p50_ms": round(_pct(answered, 0.50) * 1e3, 3),
+        "latency_p99_ms": round(_pct(answered, 0.99) * 1e3, 3),
+        "latency_by_class": {
+            klass: {
+                "count": len(lats),
+                "p50_ms": round(_pct(lats, 0.50) * 1e3, 3),
+                "p99_ms": round(_pct(lats, 0.99) * 1e3, 3),
+            }
+            for klass, lats in by_class.items()
+        },
         "mean_batch_occupancy": round(
             server_metrics.get("mean_batch_occupancy", 0.0), 3
         ),
@@ -195,6 +279,136 @@ def run_loadgen(
         "server_compile_count": server_metrics.get("compile_count"),
         "image_shape": list(image_shape),
     }
+    return result
+
+
+# ------------------------------------------------------------------ fleet
+
+
+def run_fleet_chaos(args) -> dict:
+    """Spawn `python -m rt1_tpu.serve.fleet`, drive load through the
+    router while the supervisor injects the fault schedule, and fold the
+    fleet's own evidence (restarts, reloads, per-replica compile counts)
+    into the BENCH record."""
+    cmd = [
+        sys.executable, "-m", "rt1_tpu.serve.fleet",
+        "--replicas", str(args.fleet),
+        "--port", "0",
+        "--max_sessions", str(args.max_sessions),
+        "--chaos_interval_s", str(args.chaos_interval_s),
+        "--replica_timeout_s", str(args.replica_timeout_s),
+    ]
+    if args.faults:
+        cmd += ["--faults", args.faults]
+    if args.log_dir:
+        cmd += ["--log_dir", args.log_dir]
+    if args.stub:
+        cmd += ["--stub"]
+    else:
+        cmd += ["--config", args.config, "--embedder", args.embedder]
+        if args.workdir:
+            cmd += ["--workdir", args.workdir]
+        else:
+            cmd += ["--random_init"]
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    final_line = {}
+    try:
+        # The fleet prints its ready-line only after EVERY replica passed
+        # warm-up, so the chaos clock and the load start together.
+        deadline = time.time() + args.fleet_warmup_timeout_s
+        ready = None
+        while ready is None:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet exited rc={proc.returncode} before ready"
+                )
+            if time.time() > deadline:
+                raise TimeoutError("fleet not ready in time")
+            line = proc.stdout.readline()
+            if not line:
+                time.sleep(0.1)
+                continue
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if parsed.get("status") == "serving":
+                ready = parsed
+        url = f"http://127.0.0.1:{ready['port']}"
+
+        result = run_loadgen(
+            url,
+            sessions=args.sessions,
+            steps=args.steps,
+            duration_s=args.duration,
+            think_time_s=args.think_time,
+            timeout=args.timeout,
+            max_retries=args.max_retries,
+            seed=args.seed,
+        )
+        # Let the fleet heal before sampling the final evidence: a
+        # replica killed late in the window may still be respawning (jax
+        # boot + AOT compile), and its compile_count/reloads can only be
+        # probed once it serves again.
+        heal_deadline = time.time() + args.fleet_warmup_timeout_s
+        while time.time() < heal_deadline:
+            fleet_status = _get(url + "/fleet/status", args.timeout)
+            if fleet_status.get("replicas_ready") == args.fleet:
+                break
+            time.sleep(1.0)
+        router_metrics = _get(url + "/metrics", args.timeout)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, _ = proc.communicate(timeout=60)
+            for line in reversed(stdout.splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if parsed.get("status") == "stopped":
+                    final_line = parsed
+                    break
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    compile_counts = [
+        (r.get("metrics") or {}).get("compile_count")
+        for r in fleet_status.get("replicas", [])
+    ]
+    result.update(
+        {
+            "metric": "serve_fleet_requests_per_sec",
+            "fleet_replicas": args.fleet,
+            "faults": args.faults,
+            "chaos_interval_s": args.chaos_interval_s,
+            "sessions_restarted_total": router_metrics.get(
+                "sessions_restarted_total"
+            ),
+            "replica_restarts_total": fleet_status.get(
+                "replica_restarts_total"
+            ),
+            "replicas_ready_at_end": fleet_status.get("replicas_ready"),
+            "fleet_reloads": [
+                (r.get("metrics") or {}).get("reloads_total")
+                for r in fleet_status.get("replicas", [])
+            ],
+            # The single-compile invariant, per replica LIFETIME: every
+            # live replica (including post-kill respawns) compiled once.
+            "replica_compile_counts": compile_counts,
+            "chaos": final_line.get("chaos"),
+            "stub": bool(args.stub),
+        }
+    )
+    # A fleet bench's occupancy/compile fields come from the router, which
+    # has no engine (its ServeMetrics never observes a batch) — drop the
+    # misleading single-server fields rather than report fabricated 0.0s;
+    # per-replica evidence lives in replica_compile_counts/fleet_reloads.
+    result.pop("server_compile_count", None)
+    result.pop("mean_batch_occupancy", None)
+    result.pop("max_batch_occupancy", None)
+    return result
 
 
 def main() -> int:
@@ -204,6 +418,18 @@ def main() -> int:
     parser.add_argument("--url", default="http://127.0.0.1:8321")
     parser.add_argument("--sessions", type=int, default=8)
     parser.add_argument("--steps", type=int, default=32)
+    parser.add_argument(
+        "--duration", type=float, default=0.0,
+        help="Run each session for this many seconds instead of --steps "
+             "(chaos windows are time-shaped, not count-shaped).")
+    parser.add_argument(
+        "--think_time", type=float, default=0.0,
+        help="Mean seconds between a session's requests, jittered "
+             "uniform [0, 2x] (0 = closed loop, back-to-back).")
+    parser.add_argument(
+        "--max_retries", type=int, default=400,
+        help="Busy-retry budget per request; past it the request counts "
+             "as 'rejected'.")
     parser.add_argument(
         "--height", type=int, default=0,
         help="Frame height (0 = read from /healthz).")
@@ -215,19 +441,51 @@ def main() -> int:
     parser.add_argument(
         "--output", default="",
         help="Also write the JSON to this path (stdout either way).")
+    # Fleet mode: spawn and chaos-drive python -m rt1_tpu.serve.fleet.
+    parser.add_argument(
+        "--fleet", type=int, default=0,
+        help="Spawn a fleet of N replicas behind the router and drive "
+             "load through it (0 = plain --url mode).")
+    parser.add_argument("--config", default="",
+                        help="[fleet] config path for real replicas.")
+    parser.add_argument("--workdir", default="",
+                        help="[fleet] checkpoint dir for real replicas.")
+    parser.add_argument("--random_init", action="store_true",
+                        help="[fleet] serve random init (implied when no "
+                             "--workdir).")
+    parser.add_argument("--stub", action="store_true",
+                        help="[fleet] model-free stub replicas.")
+    parser.add_argument("--embedder", default="hash")
+    parser.add_argument("--max_sessions", type=int, default=8)
+    parser.add_argument(
+        "--faults", default="",
+        help="[fleet] chaos plan, e.g. 'replica_kill@1,serve_reload@2'.")
+    parser.add_argument("--chaos_interval_s", type=float, default=2.0)
+    parser.add_argument("--replica_timeout_s", type=float, default=15.0)
+    parser.add_argument("--fleet_warmup_timeout_s", type=float, default=600.0)
+    parser.add_argument("--log_dir", default="",
+                        help="[fleet] per-replica stderr log dir.")
     args = parser.parse_args()
 
-    image_shape = None
-    if args.height and args.width:
-        image_shape = (args.height, args.width, 3)
-    result = run_loadgen(
-        args.url,
-        sessions=args.sessions,
-        steps=args.steps,
-        image_shape=image_shape,
-        timeout=args.timeout,
-        seed=args.seed,
-    )
+    if args.fleet > 0:
+        if not args.stub and not args.config:
+            parser.error("--fleet needs --config (or --stub)")
+        result = run_fleet_chaos(args)
+    else:
+        image_shape = None
+        if args.height and args.width:
+            image_shape = (args.height, args.width, 3)
+        result = run_loadgen(
+            args.url,
+            sessions=args.sessions,
+            steps=args.steps,
+            duration_s=args.duration,
+            think_time_s=args.think_time,
+            image_shape=image_shape,
+            timeout=args.timeout,
+            max_retries=args.max_retries,
+            seed=args.seed,
+        )
     line = json.dumps(result)
     print(line)
     if args.output:

@@ -37,6 +37,16 @@ def _clean_faults():
 def test_parse_grammar_and_validation():
     plan = faults.FaultPlan.parse("nan_batch@7, ckpt_save@2x3")
     assert len(plan) == 2
+    # Serve-fleet chaos sites ride the same grammar; indices are chaos
+    # ticks (fleet supervision cycles), matched index-based.
+    serve_plan = faults.FaultPlan.parse(
+        "replica_kill@1,serve_reload@2,replica_hang@3"
+    )
+    assert len(serve_plan) == 3
+    assert serve_plan.should_fire("replica_kill", index=1)
+    assert not serve_plan.should_fire("replica_kill", index=2)  # budget 1
+    assert serve_plan.should_fire("serve_reload", index=2)
+    assert serve_plan.should_fire("replica_hang", index=3)
     assert faults.FaultPlan.parse("").fired_counts() == {}
     with pytest.raises(ValueError, match="unknown fault site"):
         faults.FaultPlan.parse("bogus_site@1")

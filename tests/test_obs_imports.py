@@ -63,6 +63,19 @@ text = ServeMetrics().prometheus_text(active_sessions=0)
 assert "# TYPE rt1_serve_requests_total counter" in text
 assert 'le="+Inf"' in text
 
+# Fleet layer: router, supervisor, and the stub replica are the pieces a
+# model-free router process runs — all must work under the same blocker.
+from rt1_tpu.serve.router import Router
+from rt1_tpu.serve.stub import StubReplicaApp
+import rt1_tpu.serve.fleet  # noqa: F401 - import-time deps only
+
+router_text = Router().metrics_prometheus()
+assert "rt1_serve_replicas_total" in router_text
+assert "# TYPE rt1_serve_reloads_total counter" in router_text
+stub = StubReplicaApp(replica_id=7)
+assert stub.healthz()["replica_id"] == 7
+assert stub.readyz()[0] == 200
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

@@ -92,12 +92,24 @@ def test_render_serve_snapshot_end_to_end():
     metrics.observe_request(0.2, ok=False)
     metrics.observe_batch(4, queued=1)
     metrics.observe_step(0.008)
+    metrics.observe_reload()
+    metrics.observe_session_restart()
+    metrics.observe_session_restart()
 
-    snap = metrics.snapshot(active_sessions=2, compile_count=np.int64(1))
+    snap = metrics.snapshot(
+        active_sessions=2, compile_count=np.int64(1), replica_id=3
+    )
     text = prom.render_serve_snapshot(snap)
     types, samples = parse_exposition(text)
 
     assert types["rt1_serve_requests_total"] == "counter"
+    # Fleet counters/gauges follow the same naming contract: the hot-swap
+    # and re-home counters are counters, replica identity is a gauge, and
+    # uptime keeps its _seconds suffix.
+    assert types["rt1_serve_reloads_total"] == "counter"
+    assert types["rt1_serve_sessions_restarted_total"] == "counter"
+    assert types["rt1_serve_replica_id"] == "gauge"
+    assert types["rt1_serve_uptime_seconds"] == "gauge"
     assert types["rt1_serve_request_latency_seconds"] == "histogram"
     assert types["rt1_serve_step_latency_seconds"] == "histogram"
     assert types["rt1_serve_active_sessions"] == "gauge"
@@ -107,6 +119,9 @@ def test_render_serve_snapshot_end_to_end():
     assert by_name["rt1_serve_request_latency_seconds_count"] == "4"
     assert by_name["rt1_serve_active_sessions"] == "2"
     assert by_name["rt1_serve_compile_count"] == "1"
+    assert by_name["rt1_serve_reloads_total"] == "1"
+    assert by_name["rt1_serve_sessions_restarted_total"] == "2"
+    assert by_name["rt1_serve_replica_id"] == "3"
     # JSON snapshot and text expose the same bucket data.
     inf_bucket = [
         int(v)

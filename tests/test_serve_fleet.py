@@ -1,0 +1,232 @@
+"""Fleet layer tier-1: router affinity, replica kill + session re-home,
+rolling reload — against real SUBPROCESS replicas, using the model-free
+stub (`rt1_tpu/serve/stub.py`) so two replicas spawn in ~a second instead
+of paying a jax import + AOT compile each. The stub speaks the exact
+replica HTTP contract; the jax engine behind that contract is covered by
+test_serve_engine/test_serve_server, and the full real-replica chaos run
+is the slow-marked loadgen test at the bottom (the BENCH_serve_fleet.json
+producer).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rt1_tpu.serve.fleet import FleetSupervisor
+from rt1_tpu.serve.router import DEAD, READY, Router, make_router_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stub_argv(replica_id: int):
+    return [
+        sys.executable, "-m", "rt1_tpu.serve.stub",
+        "--port", "0",
+        "--replica_id", str(replica_id),
+    ]
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _act(url, session_id):
+    return _post(
+        url + "/act",
+        {"session_id": session_id, "image_b64": "AAAA", "instruction": "x"},
+    )
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Two supervised stub replicas behind a routed HTTP frontend. The
+    kill test at the bottom of the file relies on the supervisor healing
+    the fleet back to 2-ready before the module ends."""
+    router = Router(replica_timeout_s=10.0)
+    supervisor = FleetSupervisor(
+        router,
+        _stub_argv,
+        2,
+        poll_interval_s=0.1,
+        chaos_interval_s=3600.0,  # no chaos unless a test asks
+        warmup_timeout_s=60.0,
+    )
+    supervisor.start(wait_ready=True)
+    httpd = make_router_server(router, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield router, supervisor, url
+    httpd.shutdown()
+    httpd.server_close()
+    thread.join(timeout=5)
+    supervisor.stop()
+
+
+def test_fleet_ready_with_proxied_contract(fleet):
+    router, _, url = fleet
+    assert router.ready_count() == 2
+    status, body = _get(url + "/readyz")
+    assert status == 200 and body["ready"] is True
+    status, health = _get(url + "/healthz")
+    assert status == 200
+    # The router proxies the serving contract from a ready replica, so
+    # loadgen reads image_shape from the fleet exactly like from one node.
+    assert health["image_shape"] == [8, 14, 3]
+    assert health["replicas_total"] == 2
+    status, fs = _get(url + "/fleet/status")
+    assert status == 200
+    assert [r["state"] for r in fs["replicas"]] == [READY, READY]
+    assert all(
+        r["metrics"]["compile_count"] == 1 for r in fs["replicas"]
+    )
+
+
+def test_session_affinity_and_spread(fleet):
+    _, _, url = fleet
+    # One session's acts all land on one replica, stepping in order...
+    homes = set()
+    for expected_step in range(3):
+        status, body = _act(url, "affine")
+        assert status == 200
+        assert body["step_index"] == expected_step
+        homes.add(body["replica_id"])
+    assert len(homes) == 1
+    # ...while new sessions spread to the least-loaded replica.
+    status, body = _act(url, "affine-2")
+    assert status == 200
+    assert body["replica_id"] != next(iter(homes))
+
+
+def test_rolling_reload_hits_every_replica(fleet):
+    router, _, url = fleet
+    status, body = _post(url + "/reload", {"step": 11})
+    assert status == 200, body
+    assert body["ok"] is True
+    assert [r["status"] for r in body["replicas"]] == [200, 200]
+    assert all(r["checkpoint_step"] == 11 for r in body["replicas"])
+    # Every replica hot-swapped exactly once and returned to ready.
+    status, fs = _get(url + "/fleet/status")
+    assert [r["metrics"]["reloads_total"] for r in fs["replicas"]] == [1, 1]
+    assert router.ready_count() == 2
+    # Traffic still flows after the roll.
+    status, _ = _act(url, "post-reload")
+    assert status == 200
+
+
+def test_replica_kill_rehomes_sessions_with_restarted_flag(fleet):
+    """The headline semantics: SIGKILL a replica mid-conversation; every
+    session homed there re-homes to the live replica on its next /act —
+    a 200 carrying restarted: true and a fresh window, never a 5xx — and
+    the supervisor respawns the dead replica behind warm-up gating."""
+    router, supervisor, url = fleet
+    # Home two sessions and advance them a few steps.
+    victims = {}
+    for sid in ("kill-a", "kill-b", "kill-c", "kill-d"):
+        for _ in range(3):
+            status, body = _act(url, sid)
+            assert status == 200
+        victims[sid] = body["replica_id"]
+    target_id = victims["kill-a"]
+    on_target = [s for s, r in victims.items() if r == target_id]
+    assert on_target  # at least kill-a
+    target = next(r for r in router.replicas() if r.id == target_id)
+    restarts_before = target.restarts
+
+    target.proc.kill()
+    target.proc.wait(timeout=10)
+
+    # Sessions on the dead replica: next act is a re-homed 200 with the
+    # restart surfaced; their windows restart from step 0.
+    for sid in on_target:
+        status, body = _act(url, sid)
+        assert status == 200, body
+        assert body["restarted"] is True
+        # (No assertion on WHICH replica serves the re-home: if the
+        # supervisor respawns the dead slot fast enough it is a legal —
+        # least-loaded — placement target again.)
+        assert body["step_index"] == 0
+        assert body["session_started"] is True
+    # Sessions elsewhere never noticed.
+    unaffected = [s for s, r in victims.items() if r != target_id]
+    for sid in unaffected:
+        status, body = _act(url, sid)
+        assert status == 200
+        assert "restarted" not in body
+        assert body["step_index"] == 3
+    snapshot = router.metrics_snapshot()
+    assert snapshot["sessions_restarted_total"] == len(on_target)
+
+    # The supervisor respawns the replica (fresh process, warm-up gated)
+    # and the fleet heals back to 2-ready.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and router.ready_count() < 2:
+        time.sleep(0.1)
+    assert router.ready_count() == 2
+    assert target.restarts == restarts_before + 1
+    assert target.state == READY and target.state != DEAD
+
+
+@pytest.mark.slow
+def test_fleet_chaos_loadgen_real_replicas(tmp_path):
+    """The acceptance run, end to end with REAL jax replicas: loadgen
+    spawns `python -m rt1_tpu.serve.fleet` on the tiny config, injects
+    replica_kill + serve_reload from the deterministic fault plan, and
+    the run must finish with zero failed requests and one AOT compile per
+    replica lifetime. (Slow: two jax subprocess boots + AOT compiles.)"""
+    output = tmp_path / "bench_fleet.json"
+    cmd = [
+        sys.executable,
+        os.path.join(REPO, "scripts", "serve_loadgen.py"),
+        "--fleet", "2",
+        "--config", os.path.join(REPO, "rt1_tpu/train/configs/tiny.py"),
+        "--random_init",
+        "--sessions", "4",
+        "--duration", "16",
+        "--think_time", "0.02",
+        "--chaos_interval_s", "4.0",
+        "--replica_timeout_s", "10.0",
+        "--faults", "replica_kill@1,serve_reload@2",
+        "--log_dir", str(tmp_path / "logs"),
+        "--output", str(output),
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900, cwd=REPO, env=env
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr[-3000:]}"
+    )
+    result = json.loads(output.read_text())
+    assert result["requests_failed"] == 0
+    assert result["requests_ok"] > 0
+    assert result["chaos"]["kills_injected"] == 1
+    assert result["chaos"]["reloads_injected"] == 1
+    assert result["replica_restarts_total"] == 1
+    # One XLA compile per replica lifetime, kill + respawn included.
+    assert all(c == 1 for c in result["replica_compile_counts"])

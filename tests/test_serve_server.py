@@ -257,6 +257,171 @@ def test_readyz_warming_before_first_compile(serving_stack):
         cold._loop.close()
 
 
+def test_metrics_fleet_gauges_present(serving_stack):
+    """Satellite contract: replica_id, uptime_s, reloads_total, and
+    sessions_restarted_total appear in both /metrics formats."""
+    _, _, _, url = serving_stack
+    _, body = _get(url + "/metrics")
+    assert body["replica_id"] == 0
+    assert body["uptime_s"] > 0
+    assert body["reloads_total"] == 0
+    assert body["sessions_restarted_total"] == 0
+    assert body["reloading"] == 0
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        text = resp.read().decode("utf-8")
+    assert "# TYPE rt1_serve_reloads_total counter" in text
+    assert "# TYPE rt1_serve_sessions_restarted_total counter" in text
+    assert "rt1_serve_replica_id 0" in text
+
+
+def test_reload_endpoint_requires_a_source(serving_stack):
+    """The module app has no reload_fn: POST /reload is a clean 400, not
+    a crash."""
+    _, _, _, url = serving_stack
+    status, body = _post(url + "/reload", {})
+    assert status == 400 and "no reload source" in body["error"]
+    status, body = _post(url + "/reload", {"step": "seven"})
+    assert status == 400 and "integer" in body["error"]
+
+
+def test_reload_endpoint_hot_swaps_without_recompile(serving_stack):
+    """POST /reload on an app with a reload source: params swap in with
+    the same action stream (identical params), one compile, counters up,
+    and in-flight traffic keeps flowing (the swap lands between batches)."""
+    import jax
+
+    from rt1_tpu.serve import ServeApp
+
+    _, engine, _, _ = serving_stack
+    reloads_before = engine.reloads
+    host_vars = jax.tree.map(lambda x: np.asarray(x), engine._variables)
+    app2 = ServeApp(
+        engine,
+        image_shape=(H, W, 3),
+        embed_dim=D,
+        replica_id=5,
+        reload_fn=lambda step: (host_vars, step if step is not None else 42),
+    )
+    app2.start(warmup=True)  # engine already compiled: no second compile
+    httpd = make_server(app2, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url2 = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        frame = np.zeros((H, W, 3), np.float32).tolist()
+        act = {
+            "session_id": "hs",
+            "image": frame,
+            "instruction": "push the red moon to the blue cube",
+        }
+        status, before = _post(url2 + "/act", act)
+        assert status == 200
+
+        status, body = _post(url2 + "/reload", {})
+        assert status == 200, body
+        assert body["ok"] is True
+        assert body["checkpoint_step"] == 42
+        assert body["params_swapped"] > 0
+
+        status, body = _post(url2 + "/reload", {"step": 7})
+        assert status == 200 and body["checkpoint_step"] == 7
+
+        # Identical params: the continuing session's policy is unchanged;
+        # the engine never recompiled; both reload counters advanced.
+        status, after = _post(url2 + "/act", act)
+        assert status == 200
+        assert engine.compile_count == 1
+        assert engine.reloads == reloads_before + 2
+        _, metrics = _get(url2 + "/metrics")
+        assert metrics["reloads_total"] == 2
+        assert metrics["replica_id"] == 5
+        assert metrics["compile_count"] == 1
+        health = app2.healthz()
+        assert health["replica_id"] == 5 and health["reloads"] >= 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)
+        app2.drain()
+
+
+def test_act_admitted_during_drain_race_is_flushed(serving_stack):
+    """The drain/in-flight race regression (ISSUE 6 satellite): a request
+    that passed admission just before SIGTERM's drain() flips `draining`
+    must be flushed with a 200, never answered 503. The test freezes a
+    request INSIDE the admission window (after the draining check, before
+    its submit is scheduled) by shimming run_coroutine_threadsafe, then
+    fires the drain path concurrently — exactly what the SIGTERM handler
+    runs (install_signal_handlers -> app.drain)."""
+    import asyncio as real_asyncio
+    import time as _time
+
+    from rt1_tpu.serve import DrainingError, ServeApp
+    from rt1_tpu.serve import server as server_mod
+
+    _, engine, _, _ = serving_stack
+    app2 = ServeApp(engine, image_shape=(H, W, 3), embed_dim=D)
+    app2.start(warmup=True)
+    obs = {
+        "image": np.zeros((H, W, 3), np.float32),
+        "natural_language_embedding": np.zeros(D, np.float32),
+    }
+
+    in_window = threading.Event()
+    release = threading.Event()
+
+    class SlowSubmitAsyncio:
+        """Delegates to asyncio, but parks submit-coroutine scheduling
+        until released — holding the request in the race window."""
+
+        def __getattr__(self, name):
+            return getattr(real_asyncio, name)
+
+        def run_coroutine_threadsafe(self, coro, loop):
+            if getattr(coro, "__qualname__", "").endswith("submit"):
+                in_window.set()
+                release.wait(10)
+            return real_asyncio.run_coroutine_threadsafe(coro, loop)
+
+    orig = server_mod.asyncio
+    server_mod.asyncio = SlowSubmitAsyncio()
+    result = {}
+    try:
+        def racing_act():
+            try:
+                result["out"] = app2.act("race", obs)
+            except Exception as exc:  # noqa: BLE001 - recorded for assert
+                result["exc"] = exc
+
+        actor = threading.Thread(target=racing_act)
+        actor.start()
+        assert in_window.wait(5)  # admitted, submit not yet scheduled
+
+        drainer = threading.Thread(target=app2.drain)
+        drainer.start()
+        _time.sleep(0.3)
+        # drain() must WAIT for the admitted request's handshake instead
+        # of racing past it into the batcher shutdown.
+        assert drainer.is_alive()
+
+        release.set()
+        actor.join(timeout=15)
+        drainer.join(timeout=15)
+        assert not actor.is_alive() and not drainer.is_alive()
+    finally:
+        release.set()
+        server_mod.asyncio = orig
+    # The admitted request was flushed, not 503'd...
+    assert "exc" not in result, f"admitted act rejected: {result.get('exc')}"
+    assert "action" in result["out"]
+    # ...and post-drain admissions are refused.
+    with pytest.raises(DrainingError):
+        app2.act("late", obs)
+
+
 def test_drain_rejects_new_work(serving_stack):
     """Runs last (name-independent: fixtures are module-scoped, and this
     mutates app state — keep it after the traffic tests)."""
