@@ -112,6 +112,18 @@ def main():
              "host additionally includes feeder contention. The headline "
              "metric stays the pack-free number. Composable with --guard.")
     p.add_argument(
+        "--mixed_precision", action="store_true",
+        help="mfu/e2e modes: A/B the true-mixed-precision train step "
+             "(f32 master params + one in-step bf16 cast for fwd/bwd, "
+             "trainer/train.py mixed_precision=True) against the step as "
+             "configured, using the PR 5 interleaved-window methodology "
+             "(alternating order per round, best-of-N floors on both "
+             "sides). Pass --dtype float32 for a clean f32-vs-mixed "
+             "comparison; the headline metric stays the configured-step "
+             "number, the A/B lands in the *_detail stderr line "
+             "(mfu_mixed_precision / e2e_mp_steps_per_sec_per_chip + "
+             "mp_speedup_pct).")
+    p.add_argument(
         "--trace_dir", default="",
         help="Capture a jax.profiler trace of the measured loop into this "
              "directory (TensorBoard/XProf format; works on TPU and CPU) "
@@ -227,26 +239,28 @@ def main():
     from rt1_tpu.specs import language_table_action_space, sample_space
     from rt1_tpu.trainer import create_train_state, make_optimizer, make_train_step_fns
 
-    if args.model == "tiny":
-        # The REAL tiny config, not a copy: retuning configs/tiny.py
-        # retunes the '_tiny' bench metrics with it. Only the bench-axis
-        # knobs (seq len to match the e2e window, attention impl, dtype)
-        # are overridden.
-        from rt1_tpu.train.configs import tiny as tiny_config
-        from rt1_tpu.train.train import build_model
+    def build_bench_model(dtype):
+        if args.model == "tiny":
+            # The REAL tiny config, not a copy: retuning configs/tiny.py
+            # retunes the '_tiny' bench metrics with it. Only the bench-axis
+            # knobs (seq len to match the e2e window, attention impl, dtype)
+            # are overridden.
+            from rt1_tpu.train.configs import tiny as tiny_config
+            from rt1_tpu.train.train import build_model
 
-        mc = tiny_config.get_config().model
-        mc.time_sequence_length = 6
-        mc.attention_impl = args.attention_impl
-        mc.dtype = args.dtype
-        model = build_model(mc)
-    else:
-        model = RT1Policy(
+            mc = tiny_config.get_config().model
+            mc.time_sequence_length = 6
+            mc.attention_impl = args.attention_impl
+            mc.dtype = dtype
+            return build_model(mc)
+        return RT1Policy(
             action_space=language_table_action_space(),
             time_sequence_length=6,
-            dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+            dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
             attention_impl=args.attention_impl,
         )
+
+    model = build_bench_model(args.dtype)
     rng = jax.random.PRNGKey(0)
     b, t = args.batch, 6
     obs = {
@@ -270,10 +284,26 @@ def main():
     state = fns.shard_state(state)
     batch = fns.shard_batch((obs, actions))
 
-    def timed_resident_loop(state, steps, warmup, resident=None, trace=False):
+    # --mixed_precision A side = the configured step above; B side = the
+    # true-mixed-precision program (bf16 compute model + one in-step cast
+    # of the f32 masters). Same state/shardings, so the two programs
+    # interleave over one donated state.
+    mp_step = None
+    if args.mixed_precision and args.mode in ("mfu", "e2e"):
+        mp_fns = make_train_step_fns(
+            build_bench_model("bfloat16"), mesh, state, mixed_precision=True
+        )
+        mp_step = mp_fns.train_step
+    elif args.mixed_precision:
+        print("bench: --mixed_precision only applies to --mode mfu/e2e; "
+              "ignored", file=sys.stderr)
+
+    def timed_resident_loop(state, steps, warmup, resident=None, trace=False,
+                            step_fn=None):
+        step_fn = fns.train_step if step_fn is None else step_fn
         resident = batch if resident is None else resident
         for i in range(warmup):
-            state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, i))
+            state, metrics = step_fn(state, resident, jax.random.fold_in(rng, i))
             jax.block_until_ready(metrics["loss"])
         from rt1_tpu.obs import trace as obs_trace
 
@@ -281,7 +311,7 @@ def main():
             t0 = time.perf_counter()
             for i in range(steps):
                 with obs_trace.span("bench_step", step=i):
-                    state, metrics = fns.train_step(state, resident, jax.random.fold_in(rng, 100 + i))
+                    state, metrics = step_fn(state, resident, jax.random.fold_in(rng, 100 + i))
             jax.block_until_ready(metrics["loss"])
             # dt read INSIDE the trace context: trace stop/serialization
             # can take seconds and must not deflate the published number.
@@ -290,7 +320,8 @@ def main():
 
     if args.mode == "mfu":
         return mfu_bench(
-            args, fns, state, batch, rng, n_chips, timed_resident_loop, variant
+            args, fns, state, batch, rng, n_chips, timed_resident_loop,
+            variant, mp_step=mp_step,
         )
 
     for flag in ("guard", "health"):
@@ -322,6 +353,7 @@ def main():
         return e2e_bench(
             args, fns, state, rng, n_chips, timed_resident_loop, variant,
             guarded_step=guarded_step, health_step=health_step,
+            mp_step=mp_step,
         )
 
     # Best-of-N windows: min time ~= noise-free sustained throughput; a
@@ -518,7 +550,7 @@ def _e2e_feed(args, fns):
 
 
 def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop, variant="",
-              guarded_step=None, health_step=None):
+              guarded_step=None, health_step=None, mp_step=None):
     """Pipeline-fed steps: host windowing/augment -> uint8 H2D (double-
     buffered) -> device step. The number BASELINE.md's wall-clock north star
     actually cares about; `stall_pct` on stderr is the input-bound fraction.
@@ -562,6 +594,8 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop, variant="",
         alternates["guard"] = guarded_step
     if health_step is not None:
         alternates["health"] = health_step
+    if mp_step is not None:
+        alternates["mp"] = mp_step
     for k, stepfn in enumerate(alternates.values()):
         for i in range(args.warmup):
             state, metrics = stepfn(
@@ -684,6 +718,15 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop, variant="",
         e2e_guard = args.steps / min(windows["guard"]) / n_chips
         detail["e2e_guarded_steps_per_sec_per_chip"] = round(e2e_guard, 4)
         detail["guard_overhead_pct"] = round(overhead_pct("guard"), 2)
+    if "mp" in alternates:
+        # Mixed precision is a SPEEDUP candidate, not an overhead budget:
+        # report the signed delta of the window floors (negative = mp
+        # slower — expected on XLA:CPU hosts, which emulate bf16 via f32).
+        e2e_mp = args.steps / min(windows["mp"]) / n_chips
+        detail["e2e_mp_steps_per_sec_per_chip"] = round(e2e_mp, 4)
+        detail["mp_speedup_pct"] = round(
+            (best_dt / min(windows["mp"]) - 1.0) * 100.0, 2
+        )
     if "health" in alternates:
         e2e_health = args.steps / min(windows["health"]) / n_chips
         detail["e2e_health_steps_per_sec_per_chip"] = round(e2e_health, 4)
@@ -714,7 +757,8 @@ def e2e_bench(args, fns, state, rng, n_chips, timed_resident_loop, variant="",
     _dump_host_trace()
 
 
-def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop, variant=""):
+def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop,
+              variant="", mp_step=None):
     """MFU = measured FLOP/s / peak FLOP/s, with FLOPs from XLA's own cost
     analysis of the compiled train step (fwd+bwd+update, the whole program).
     Peak defaults to a v5e chip's bf16 197 TFLOP/s; override with
@@ -723,6 +767,13 @@ def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop, varian
     The estimator itself lives in rt1_tpu/obs/flops.py (shared with the
     train loop's live goodput/mfu gauge); this mode keeps the COMPILED
     (post-fusion) analysis path so published baselines stay comparable.
+
+    With ``mp_step`` (--mixed_precision) the mixed-precision program is
+    timed in windows INTERLEAVED with the configured step's, order
+    alternating per round (the PR 5 drift-cancelling methodology), each
+    side scored against its own compiled program's FLOPs; the comparison
+    lands in the mfu_detail stderr line, the headline metric stays the
+    configured step's.
     """
     import sys
 
@@ -744,25 +795,57 @@ def mfu_bench(args, fns, state, batch, rng, n_chips, timed_resident_loop, varian
             file=sys.stderr,
         )
         sys.exit(1)
+    flops_mp = None
+    if mp_step is not None:
+        flops_mp = flops_lib.train_step_flops(
+            mp_step, state, batch, jax.random.fold_in(rng, 0), compile=True
+        )
 
     dt = None
+    dt_mp = None
     for w in range(max(1, args.windows)):
-        state, dt_w = timed_resident_loop(
-            state, args.steps, args.warmup if w == 0 else 0
-        )
-        dt = dt_w if dt is None else min(dt, dt_w)
+        sides = [("base", None)]
+        if mp_step is not None:
+            sides.append(("mp", mp_step))
+        if w % 2:
+            sides = sides[::-1]
+        for name, stepfn in sides:
+            state, dt_w = timed_resident_loop(
+                state, args.steps, args.warmup if w == 0 else 0,
+                step_fn=stepfn,
+            )
+            if name == "base":
+                dt = dt_w if dt is None else min(dt, dt_w)
+            else:
+                dt_mp = dt_w if dt_mp is None else min(dt_mp, dt_w)
     dt_per_step = dt / args.steps
 
     mfu = flops_lib.mfu_pct(flops, dt_per_step, n_chips)
-    print(
-        json.dumps(
-            {
-                "mode": "mfu_detail",
-                **flops_lib.mfu_detail(flops, dt_per_step, n_chips),
-            }
-        ),
-        file=sys.stderr,
-    )
+    detail = {
+        "mode": "mfu_detail",
+        **flops_lib.mfu_detail(flops, dt_per_step, n_chips),
+    }
+    if dt_mp is not None:
+        mp_per_step = dt_mp / args.steps
+        detail["mp_step_ms"] = round(mp_per_step * 1e3, 3)
+        detail["mp_speedup_pct"] = round((dt / dt_mp - 1.0) * 100.0, 2)
+        detail["windows"] = max(1, args.windows)
+        if flops_mp is not None:
+            detail["mfu_mixed_precision"] = round(
+                flops_lib.mfu_pct(flops_mp, mp_per_step, n_chips), 3
+            )
+            detail["mp_flops_per_step"] = flops_mp
+        else:
+            # The timing A/B is already paid for and valid — publish it,
+            # but say loudly why the mp MFU column is absent rather than
+            # looking as if --mixed_precision was never passed.
+            print(
+                "bench: XLA cost analysis returned no FLOPs for the "
+                "mixed-precision step — mp_step_ms/mp_speedup_pct are "
+                "valid, mfu_mixed_precision omitted",
+                file=sys.stderr,
+            )
+    print(json.dumps(detail), file=sys.stderr)
     print(
         json.dumps(
             {

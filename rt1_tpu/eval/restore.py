@@ -82,10 +82,43 @@ def restore_variables(config, workdir, step=None):
     return model, _variables_from_state(state), restored_step, family, lava_clip
 
 
+def serving_plan(config):
+    """The declarative sharding plan for a serving process, resolved from
+    the SAME `config.parallel` block training uses (parallel/plan.py).
+
+    Serving has no batch axis to shard (sessions are slots, not data
+    shards), so `dp` collapses to 1 and the mesh covers exactly the
+    fsdp × tp × pp × sp devices model parallelism needs — for the default
+    all-ones config that is a 1-device mesh, byte-identical placement to
+    the pre-plan engine. Returns None when jax has no initialized backend
+    yet (callers treat that as plain placement).
+    """
+    import jax
+
+    from rt1_tpu.parallel import ShardingPlan
+
+    try:
+        devices = jax.local_devices()
+    except RuntimeError:  # no initialized backend — plain placement
+        return None
+    # One resolver with train (`auto` resolves against THIS host's devices,
+    # the data axis collapses — sessions are slots, not shards); see
+    # ShardingPlan.from_config(collapse_data=True).
+    return ShardingPlan.from_config(
+        config, devices=devices, collapse_data=True
+    )
+
+
 def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
     """Feed a checkpoint (or random init when `workdir` is None) into a
     multi-session serving engine. Returns (engine, checkpoint_step);
-    checkpoint_step is -1 for random init."""
+    checkpoint_step is -1 for random init.
+
+    Params are restored through the sharding plan (`serving_plan`): the
+    engine places every leaf per the plan rule on the serve mesh, so a
+    tensor-parallel or fsdp-sharded engine is the same config switch as in
+    training — no per-callsite spec plumbing.
+    """
     from rt1_tpu.serve.engine import PolicyEngine
 
     if workdir is None:
@@ -100,6 +133,11 @@ def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
             f"the serving engine batches RT-1 rolling network state; "
             f"family={family!r} is not servable (use the eval harness)"
         )
+    if "plan" not in engine_kwargs:
+        # Resolved lazily: an explicitly passed plan (or plan=None for
+        # plain placement) must not trigger serving_plan's device-count
+        # validation for a layout that will never be built.
+        engine_kwargs["plan"] = serving_plan(config)
     return PolicyEngine(model, variables, **engine_kwargs), restored_step
 
 
@@ -110,7 +148,10 @@ def load_standby_variables(config, workdir=None, step=None):
     Returns (variables, checkpoint_step) with every leaf a numpy array —
     the standby buffer `PolicyEngine.swap_variables` validates before any
     device memory is touched, so a corrupt checkpoint is rejected while
-    the old params keep serving. `workdir=None` rebuilds the same
+    the old params keep serving. Leaves keep the checkpoint's MASTER
+    dtypes (f32 even for a bf16-compute engine) — swap_variables validates
+    against the serving masters, and the engine re-places the buffer with
+    each leaf's current plan sharding on swap. `workdir=None` rebuilds the same
     deterministic PRNGKey(0) random init as `build_serve_engine`'s
     random-init path (bit-identical params — the chaos harness uses this
     to prove reload parity without a trained checkpoint). checkpoint_step

@@ -8,6 +8,10 @@ Axis conventions used throughout rt1_tpu:
 
 * ``data``  — data parallelism (batch axis). Gradient reduction becomes an XLA
   psum over ICI, replacing DDP's NCCL bucket allreduce.
+* ``fsdp``  — fully-sharded data parallelism (ZeRO-3): the batch is sharded over
+  it like ``data``, but parameters/optimizer state are *also* sharded over it
+  (per the plan in rt1_tpu/parallel/plan.py), so GSPMD emits all-gathers for
+  weights at use sites and reduce-scatters for gradients.
 * ``model`` — tensor parallelism (attention heads / FFN columns).
 * ``seq``   — sequence/context parallelism (ring attention); unused for the 66-token
   RT-1 window (SURVEY.md §5 "long-context: absent") but first-class in the API so
@@ -33,25 +37,27 @@ class MeshConfig:
     """Logical mesh shape. -1 for `data` means "all remaining devices"."""
 
     data: int = -1
+    fsdp: int = 1
     model: int = 1
     seq: int = 1
     stage: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.model * self.seq * self.stage
+        fixed = self.fsdp * self.model * self.seq * self.stage
         if n_devices % fixed != 0:
             raise ValueError(
                 f"{n_devices} devices not divisible by "
-                f"model*seq*stage={fixed}"
+                f"fsdp*model*seq*stage={fixed}"
             )
         data = self.data if self.data != -1 else n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.stage}x{self.seq}x{self.model} != "
-                f"{n_devices} devices"
+                f"mesh {data}x{self.stage}x{self.fsdp}x{self.seq}x"
+                f"{self.model} != {n_devices} devices"
             )
         return MeshConfig(
-            data=data, model=self.model, seq=self.seq, stage=self.stage
+            data=data, fsdp=self.fsdp, model=self.model, seq=self.seq,
+            stage=self.stage,
         )
 
 
@@ -59,17 +65,21 @@ def make_mesh(
     config: MeshConfig = MeshConfig(),
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a ('data', 'stage', 'seq', 'model') mesh over `devices` (default: all).
+    """Build a ('data', 'stage', 'fsdp', 'seq', 'model') mesh over `devices`
+    (default: all).
 
     Axis order puts ``model`` innermost so tensor-parallel collectives ride the
     fastest ICI links (nearest-neighbor on a TPU slice), ``data`` outermost so DP
     psum tolerates the slower hops (and DCN across hosts on multi-host slices,
-    where `jax.devices()` is already ordered host-major). ``stage`` sits next to
-    ``data``: pipeline ppermutes are point-to-point once per microbatch tick —
-    far less bandwidth-hungry than TP/SP collectives — so they get the longer
-    hops.
+    where `jax.devices()` is already ordered host-major). ``fsdp`` sits between:
+    its per-layer weight all-gathers are bandwidth-hungry like TP but overlap
+    with compute, so it takes the middle hops. ``stage`` sits next to ``data``:
+    pipeline ppermutes are point-to-point once per microbatch tick — far less
+    bandwidth-hungry than TP/SP collectives — so they get the longer hops.
     """
     devices = list(devices if devices is not None else jax.devices())
     cfg = config.resolve(len(devices))
-    arr = np.asarray(devices).reshape(cfg.data, cfg.stage, cfg.seq, cfg.model)
-    return Mesh(arr, axis_names=("data", "stage", "seq", "model"))
+    arr = np.asarray(devices).reshape(
+        cfg.data, cfg.stage, cfg.fsdp, cfg.seq, cfg.model
+    )
+    return Mesh(arr, axis_names=("data", "stage", "fsdp", "seq", "model"))

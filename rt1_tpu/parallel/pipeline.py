@@ -170,18 +170,13 @@ def pipeline_apply(
         out = jax.lax.psum(out, stage_axis)
         return out.reshape((b_local,) + x_local.shape[1:])
 
-    # XLA:CPU SPMD miscompile guard (jax 0.4.x): a stack/concatenate of
-    # per-layer params resharded straight into P(stage) on a mesh with a >1
-    # second axis SUMS the data-axis replicas into each stage shard (each
-    # stage then sees 2x params on a data=2 mesh). Pinning the stacked tree
-    # to an explicit replicated layout first forces the partitioner to
-    # materialize the value before the stage reshard, which compiles
-    # correctly. Pinned in tests/test_pipeline.py::test_pp_train_step_equals_
-    # dense (the exact failure this masked).
-    repl = NamedSharding(mesh, P())
-    stacked_params = jax.tree.map(
-        lambda p: jax.lax.with_sharding_constraint(p, repl), stacked_params
-    )
+    # Pre-reshard placement comes from the plan (plan.PIPELINE_STACK_RULES),
+    # not inline special-casing: the stacked tree is pinned replicated before
+    # the P(stage) reshard — the XLA:CPU miscompile guard documented there,
+    # pinned by tests/test_pipeline.py::test_pp_train_step_equals_dense.
+    from rt1_tpu.parallel import plan as planlib
+
+    stacked_params = planlib.pipeline_stack_placement(stacked_params, mesh)
     return shard_map(
         local,
         mesh=mesh,

@@ -1,0 +1,382 @@
+"""One declarative sharding plan: name-pattern → PartitionSpec for every
+RT-1 parameter group, resolved once and consumed identically by train
+(`trainer/train.py`), eval restore (`eval/restore.py`), and serve
+(`serve/engine.py`).
+
+Before this module, parallelism was piecemeal: two hand-written rule lists in
+`parallel/sharding.py` consumed only by the trainer, an inline XLA:CPU
+replication workaround in `parallel/pipeline.py`, and ad-hoc `device_put`s on
+the eval/serve path. Here the whole layout is ONE ordered list of
+``(path-regex, PartitionSpec)`` rules in the GSPMD annotation-driven style
+(Xu et al., 2021): annotate where each weight lives, let the partitioner
+propagate everything else. The axes the specs name are the
+``('data', 'stage', 'fsdp', 'seq', 'model')`` mesh of `parallel/mesh.py`:
+
+* ``fsdp`` — ZeRO-3 weight sharding. The batch is sharded over it together
+  with ``data``; weight matrices shard one dimension over it, so GSPMD emits
+  per-layer all-gathers at use sites and reduce-scatters for gradients.
+* ``model`` — tensor parallelism (attention heads / FFN columns, MoE experts).
+
+Every spec is written against all axes; size-1 axes are free, so the same plan
+degenerates to pure DP on a `dp=N` mesh at zero cost. Kernel layouts are Flax
+Dense ``(in, out)``, which mirrors SNIPPETS.md [3]'s torch ``(out, in)``
+``('tp','fsdp')`` map transposed: column-parallel kernels are
+``P('fsdp', 'model')``, row-parallel are ``P('model', 'fsdp')``.
+
+Coverage is checked, not assumed: `sharding_for_path`'s silent replicate-on-
+no-match stays as the *mechanism*, but the plan refuses to let a weight matrix
+fall through silently — `ShardingPlan.coverage` lists every rank≥2 leaf no
+rule matched, `tree_shardings(check=True)` warns loudly (or raises in strict
+mode) so a renamed module can't quietly replicate a gigabyte of experts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from rt1_tpu.parallel.mesh import MeshConfig, make_mesh
+
+Rule = Tuple[str, P]
+
+# Mesh-shape selection by device count when `config.parallel.auto` is set:
+# n_devices -> (dp, fsdp, tp). The table follows SNIPPETS.md [1]'s shape
+# ladder (small slices mix dp×fsdp, 8 adds tp, 16 goes fsdp×tp-heavy); the
+# fallback for unlisted counts is pure fsdp — the memory-optimal default for
+# a model that fits compute-bound on every chip.
+AUTO_MESH_SHAPES = {
+    1: (1, 1, 1),
+    2: (2, 1, 1),
+    4: (2, 2, 1),
+    8: (2, 2, 2),
+    16: (1, 4, 4),
+}
+
+
+def auto_mesh_shape(n_devices: int) -> Tuple[int, int, int]:
+    """(dp, fsdp, tp) for `n_devices`, per AUTO_MESH_SHAPES."""
+    return AUTO_MESH_SHAPES.get(n_devices, (1, n_devices, 1))
+
+
+def rt1_sharding_plan() -> List[Rule]:
+    """THE plan: ordered (path-regex, PartitionSpec) over every RT-1 param
+    group. First match wins; paths are '/'-joined flax param paths.
+
+    Folds the former `rt1_parameter_rules` + `moe_parameter_rules` (which
+    covered only the decoder) and extends them to the FiLM-EfficientNet
+    tokenizer, TokenLearner, embeddings, and the action head, so the
+    coverage check can demand an explicit decision for every weight matrix.
+    Norms/biases/BN stats are explicitly replicated — listed, not fallen
+    through, so `coverage` distinguishes "decided small" from "forgotten".
+    """
+    return [
+        # --- transformer decoder: attention ---------------------------------
+        # qkv: (d_model, heads*key_dim) — columns over tp, rows over fsdp.
+        (r"transformer/layer_\d+/attn/(query|key|value)/kernel$",
+         P("fsdp", "model")),
+        (r"transformer/layer_\d+/attn/(query|key|value)/bias$", P("model")),
+        # out: (heads*key_dim, d_model) — row-parallel; GSPMD emits the psum
+        # from the contraction.
+        (r"transformer/layer_\d+/attn/out/kernel$", P("model", "fsdp")),
+        (r"transformer/layer_\d+/attn/out/bias$", P()),
+        # --- transformer decoder: FFN (single square Dense, transformer.py) -
+        (r"transformer/layer_\d+/ff/kernel$", P("fsdp", "model")),
+        (r"transformer/layer_\d+/ff/bias$", P("model")),
+        (r"transformer/layer_\d+/norm_\d+/(scale|bias)$", P()),
+        # --- Switch MoE FFN (models/moe.py) ---------------------------------
+        # fp32 router replicated so every shard routes identically.
+        (r"moe/gate/kernel$", P()),
+        # Stacked experts (E, d, ff)/(E, ff, d): experts over `model` (the
+        # dispatch/combine einsums lower to all-to-alls over ICI), the
+        # non-contracting weight dim over `fsdp`.
+        (r"moe/wi$", P("model", "fsdp", None)),
+        (r"moe/wo$", P("model", None, "fsdp")),
+        # --- embeddings + action head (the vocab head IS the action head:
+        # action tokens decode from its logits) ------------------------------
+        (r"transformer/token_emb/kernel$", P("fsdp", "model")),
+        (r"transformer/token_emb/bias$", P("model")),
+        (r"transformer/position_emb/embedding$", P(None, "fsdp")),
+        (r"transformer/output_tokens/kernel$", P("fsdp", "model")),
+        (r"transformer/output_tokens/bias$", P("model")),
+        # --- FiLM-EfficientNet tokenizer ------------------------------------
+        # FiLM projections: (512, channels) — shard the (large, always
+        # divisible) embedding dim over fsdp; channels can be as small as 8.
+        (r"projection_(add|mult)/kernel$", P("fsdp", None)),
+        (r"projection_(add|mult)/bias$", P()),
+        # Conv kernels, (kh, kw, cin, cout): output channels over fsdp.
+        # Matches the EfficientNet stem/top/expand/project/depthwise convs,
+        # the SE fc1/fc2 1x1 convs, the encoder conv1x1, the TokenLearner
+        # conv1/conv2, and the tiny tokenizer's stem conv.
+        (r"(conv|conv1|conv2|conv1x1|fc1|fc2)/kernel$",
+         P(None, None, None, "fsdp")),
+        (r"(conv|conv1|conv2|conv1x1|fc1|fc2)/bias$", P()),
+        (r"bn/(scale|bias|mean|var)$", P()),
+        (r"token_learner/norm/(scale|bias)$", P()),
+        # Vision-pretrain classifier head (train/pretrain_vision.py grafts
+        # drop it before policy training, but the encoder trains with it).
+        (r"classifier/kernel$", P(None, "fsdp")),
+        (r"classifier/bias$", P()),
+        # --- tiny tokenizer (configs/tiny.py) -------------------------------
+        (r"image_tokenizer_def/ctx_proj/kernel$", P("fsdp", None)),
+        (r"image_tokenizer_def/ctx_proj/bias$", P()),
+        (r"image_tokenizer_def/tok/kernel$", P(None, "fsdp")),
+        (r"image_tokenizer_def/tok/bias$", P()),
+    ]
+
+
+# Plan-level placement for the stacked per-layer tree pipeline_apply shards
+# over `stage`. The explicit replicated pin is load-bearing on XLA:CPU
+# (jax 0.4.x): a stack/concatenate of per-layer params resharded straight
+# into P(stage) on a mesh with another >1 axis SUMS the other axis' replicas
+# into each stage shard. Pinning the stacked tree to a replicated layout
+# first forces the partitioner to materialize the value before the stage
+# reshard, which compiles correctly (the failure it masks is pinned in
+# tests/test_pipeline.py::test_pp_train_step_equals_dense). Expressed as a
+# rule list so the workaround lives in the plan, not inline in pipeline.py.
+PIPELINE_STACK_RULES: List[Rule] = [
+    (r".*", P()),
+]
+
+
+def pipeline_stack_placement(stacked_params: Any, mesh: Mesh) -> Any:
+    """Apply the plan's pre-reshard placement to a stacked layer tree."""
+    from rt1_tpu.parallel import sharding as shardlib
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.lax.with_sharding_constraint(
+            x, shardlib.sharding_for_path(path, mesh, PIPELINE_STACK_RULES)
+        ),
+        stacked_params,
+    )
+
+
+class PlanCoverageError(ValueError):
+    """Strict mode: a weight matrix matched no plan rule."""
+
+
+def strip_fsdp_axis(spec: P) -> P:
+    """`spec` with the ``fsdp`` axis removed from every dim (the in-step
+    gathered layout: tp sharding kept, weight shards whole again).
+
+    The train step applies this as a `with_sharding_constraint` on the
+    params at step entry: weights are STORED fsdp-sharded between steps
+    (masters + optimizer moments — the ZeRO memory win) and gathered ONCE
+    per step for fwd/bwd, with the gradient/update resharded back by the
+    state's out_shardings (a reduce-scatter at the step boundary). One
+    clean all-gather per step instead of per-use resharding also sidesteps
+    the jax 0.4.x XLA:CPU partitioner's "involuntary full
+    rematerialization" paths, which miscompute on dp>1 × fsdp>1 meshes
+    when weights stay sharded through the fwd/bwd (pinned by
+    tests/test_plan.py::test_dense_fsdp_tp_pp_equivalence_on_4_devices —
+    the same bug family as PIPELINE_STACK_RULES' pin).
+    """
+    dims = []
+    for d in spec:
+        if d == "fsdp":
+            dims.append(None)
+        elif isinstance(d, (tuple, list)):
+            kept = tuple(a for a in d if a != "fsdp")
+            dims.append(kept if kept else None)
+        else:
+            dims.append(d)
+    return P(*dims)
+
+
+@dataclasses.dataclass
+class ShardingPlan:
+    """A resolved plan: mesh + rules + the batch layout, with coverage
+    checking. Built once (`from_config`) and handed to every consumer.
+    """
+
+    mesh: Mesh
+    rules: Sequence[Rule] = dataclasses.field(
+        default_factory=rt1_sharding_plan
+    )
+    strict: bool = False
+
+    # ------------------------------------------------------------ specs
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        """Mesh axes the leading batch dim shards over. FSDP is data
+        parallelism for activations, so the batch covers both axes."""
+        return ("data", "fsdp")
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Total batch-sharding ways (per_host_batch_size must divide it)."""
+        size = 1
+        for a in self.batch_axes:
+            size *= self.mesh.shape.get(a, 1)
+        return size
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.batch_axes))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------ matching
+    def spec_for(self, path_str: str) -> Optional[P]:
+        """First matching rule's spec, or None (≠ P()!) when unmatched."""
+        for pattern, spec in self.rules:
+            if re.search(pattern, path_str):
+                return spec
+        return None
+
+    def coverage(self, tree: Any) -> List[str]:
+        """Paths of rank≥2 leaves (weight matrices) no rule matched.
+
+        Rank<2 leaves (biases, norms, BN stats, scalars) may fall through
+        to replication freely — they are too small to matter; a silently
+        replicated *matrix* is the bug this check exists for.
+        """
+        from rt1_tpu.parallel import sharding as shardlib
+
+        unmatched = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if getattr(leaf, "ndim", 0) < 2:
+                continue
+            s = shardlib._path_str(path)
+            if self.spec_for(s) is None:
+                unmatched.append(s)
+        return unmatched
+
+    def check_coverage(self, tree: Any, what: str = "params") -> List[str]:
+        """Loud-warn (or strict-raise) on unmatched weight matrices."""
+        unmatched = self.coverage(tree)
+        if unmatched:
+            msg = (
+                f"sharding plan: {len(unmatched)} {what} weight matrices "
+                f"matched NO rule and would silently replicate: "
+                f"{unmatched[:8]}{'...' if len(unmatched) > 8 else ''} — "
+                f"add rules to rt1_tpu/parallel/plan.py"
+            )
+            if self.strict:
+                raise PlanCoverageError(msg)
+            import logging
+
+            logging.getLogger("rt1_tpu.parallel.plan").warning(msg)
+        return unmatched
+
+    # ------------------------------------------------------------ placement
+    def tree_shardings(self, tree: Any, check: bool = False) -> Any:
+        """Pytree of NamedShardings matching `tree` per the rules; unmatched
+        leaves replicate (after `check_coverage` when `check`)."""
+        from rt1_tpu.parallel import sharding as shardlib
+
+        if check:
+            self.check_coverage(tree)
+        return shardlib.shard_pytree(tree, self.mesh, self.rules)
+
+    def place_variables(self, variables: Any, check: bool = True) -> Any:
+        """device_put a restored `{'params': ..., 'batch_stats': ...}` tree
+        through the plan — the eval/serve placement path."""
+        return jax.device_put(
+            variables, self.tree_shardings(variables, check=check)
+        )
+
+    def gather_shardings(self, tree: Any) -> Any:
+        """Per-leaf NamedShardings for the IN-STEP layout: plan specs with
+        the fsdp axis stripped (see `strip_fsdp_axis`). Applied by the
+        train step as a with_sharding_constraint at step entry."""
+        from rt1_tpu.parallel import sharding as shardlib
+
+        def one(path, leaf):
+            spec = self.spec_for(shardlib._path_str(path))
+            spec = strip_fsdp_axis(spec if spec is not None else P())
+            shape = getattr(leaf, "shape", None)
+            if shape is not None:
+                spec = shardlib.spec_for_shape(spec, shape, self.mesh)
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map_with_path(one, tree)
+
+    # ------------------------------------------------------------ factory
+    @classmethod
+    def from_config(
+        cls,
+        config: Any = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        n_devices: Optional[int] = None,
+        collapse_data: bool = False,
+    ) -> "ShardingPlan":
+        """Resolve the plan ONCE from `config.parallel` (dp/fsdp/tp/pp/sp
+        sizes, `auto` mesh-shape selection by device count, `strict`
+        coverage), falling back to the legacy `config.mesh` block
+        (data/model/seq/stage) for configs that predate `config.parallel`,
+        and to pure DP when neither block exists (pinned proof configs).
+
+        ``collapse_data=True`` is the serving resolution (eval/restore.py
+        `serving_plan`): there is no batch axis to shard (sessions are
+        slots, not data shards), so `dp` collapses to 1 and the mesh covers
+        exactly the fsdp × tp × pp × sp devices model parallelism needs —
+        raising when the host has fewer. One resolver for train AND serve,
+        so the ladder/axes can never drift between them.
+        """
+        dp, fsdp, tp, pp, sp = -1, 1, 1, 1, 1
+        strict = False
+        par = _get(config, "parallel")
+        if par is not None:
+            if _get(par, "auto", False):
+                n = n_devices if n_devices is not None else len(
+                    devices if devices is not None else jax.devices()
+                )
+                pp = int(_get(par, "pp", 1))
+                sp = int(_get(par, "sp", 1))
+                # pp/sp are honored as configured: the auto table splits
+                # only the devices left after the stage/seq axes take
+                # theirs, so auto composes with pp>1 or sp>1 instead of
+                # over-subscribing the mesh.
+                dp, fsdp, tp = auto_mesh_shape(max(n // max(pp * sp, 1), 1))
+            else:
+                dp = int(_get(par, "dp", -1))
+                fsdp = int(_get(par, "fsdp", 1))
+                tp = int(_get(par, "tp", 1))
+                pp = int(_get(par, "pp", 1))
+                sp = int(_get(par, "sp", 1))
+            strict = bool(_get(par, "strict", False))
+        else:
+            legacy = _get(config, "mesh")
+            if legacy is not None:
+                dp = int(_get(legacy, "data", -1))
+                tp = int(_get(legacy, "model", 1))
+                sp = int(_get(legacy, "seq", 1))
+                pp = int(_get(legacy, "stage", 1))
+        if collapse_data:
+            dp = 1
+            n = fsdp * tp * pp * sp
+            pool = list(devices) if devices is not None else jax.devices()
+            if len(pool) < n:
+                raise ValueError(
+                    f"config.parallel asks for fsdp*tp*pp*sp={n} devices "
+                    f"but this serving host has {len(pool)}"
+                )
+            devices = pool[:n]
+        mesh = make_mesh(
+            MeshConfig(data=dp, fsdp=fsdp, model=tp, seq=sp, stage=pp),
+            devices=devices,
+        )
+        return cls(mesh=mesh, strict=strict)
+
+
+def _get(obj: Any, key: str, default: Any = None) -> Any:
+    """config attribute/key lookup tolerating ml_collections, dicts, None."""
+    if obj is None:
+        return default
+    if hasattr(obj, "get"):
+        try:
+            v = obj.get(key, default)
+            return default if v is None else v
+        except TypeError:
+            pass
+    v = getattr(obj, key, default)
+    return default if v is None else v
+
+
+def mixed_precision_from_config(config: Any) -> bool:
+    """The `config.parallel.mixed_precision` switch (False when absent)."""
+    return bool(_get(_get(config, "parallel"), "mixed_precision", False))

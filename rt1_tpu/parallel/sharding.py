@@ -1,4 +1,4 @@
-"""Sharding rules: map parameter paths / batch pytrees to `NamedSharding`s.
+"""Sharding mechanics: map parameter paths / batch pytrees to `NamedSharding`s.
 
 Replaces the reference's implicit "replicate everything" layout (DDP keeps a full
 model copy per GPU, `distribute_train.py:235`; `flax_utils.replicate` in Stack B,
@@ -6,11 +6,16 @@ model copy per GPU, `distribute_train.py:235`; `flax_utils.replicate` in Stack B
 list of (path-regex, PartitionSpec) pairs decides where each parameter lives, and
 GSPMD propagates everything else.
 
-Default RT-1 rules implement **tensor parallelism over the `model` axis** for the
-transformer (qkv projections column-sharded on heads, output row-sharded, FFN
-column-sharded) and replication for everything small (FiLM, norms, embeddings).
-With a size-1 `model` axis these all degenerate to pure data parallelism at zero
-cost, which is the reference-parity configuration.
+The rules themselves live in ONE place — `rt1_tpu/parallel/plan.py`'s
+declarative plan, which covers every RT-1 param group over the
+``('data', 'stage', 'fsdp', 'seq', 'model')`` mesh and carries the coverage
+check that keeps a renamed module from silently replicating. The historical
+entry points below (`rt1_parameter_rules`, `moe_parameter_rules`) are thin
+views into that plan; this module keeps the pure mechanics: path
+stringification, first-match-wins resolution, pytree mapping.
+
+With every plan axis at size 1 the specs all degenerate to pure data
+parallelism at zero cost, which is the reference-parity configuration.
 """
 
 from __future__ import annotations
@@ -34,38 +39,27 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 
 def rt1_parameter_rules() -> List[Rule]:
-    """Path-regex → PartitionSpec for RT1Policy parameters.
+    """Path-regex → PartitionSpec for RT1Policy parameters: the full
+    declarative plan (plan.py), one rule list for every param group.
 
     Paths are '/'-joined flax param paths, e.g.
     ``transformer/layer_0/attn/query/kernel``. First match wins; no match →
-    replicated. Kernel layouts: Dense kernels are (in, out).
+    replicated (but see `plan.ShardingPlan.coverage` — weight matrices are
+    not allowed to fall through silently). Kernel layouts: Dense kernels
+    are (in, out).
     """
-    return [
-        # Attention qkv: (d_model, heads*key_dim) — shard the head dim (columns).
-        (r"transformer/layer_\d+/attn/(query|key|value)/kernel$", P(None, "model")),
-        (r"transformer/layer_\d+/attn/(query|key|value)/bias$", P("model")),
-        # Attention out: (heads*key_dim, d_model) — shard rows; output needs psum,
-        # which GSPMD emits from the contraction.
-        (r"transformer/layer_\d+/attn/out/kernel$", P("model", None)),
-        # The reference's "FFN" is a single square Dense (transformer.py quirk);
-        # column-shard it — the residual add forces a gather which GSPMD places.
-        (r"transformer/layer_\d+/ff/kernel$", P(None, "model")),
-        (r"transformer/layer_\d+/ff/bias$", P("model")),
-        # Vocab head: (d_model, vocab) — column-shard.
-        (r"transformer/output_tokens/kernel$", P(None, "model")),
-        (r"transformer/output_tokens/bias$", P("model")),
-    ] + moe_parameter_rules()
+    from rt1_tpu.parallel import plan as planlib
+
+    return planlib.rt1_sharding_plan()
 
 
 def moe_parameter_rules() -> List[Rule]:
-    """Expert parallelism: stacked expert weights (E, d, ff) sharded over
-    ``model`` on the expert axis. GSPMD lowers the dispatch/combine einsums
-    (models/moe.py) to all-to-alls over ICI; the fp32 router stays
-    replicated so every shard routes identically.
+    """Expert-parallel subset of the plan (stacked expert weights sharded
+    over ``model`` on the expert axis; the fp32 router stays replicated so
+    every shard routes identically). Kept for callers that shard a bare
+    MoE tree; `rt1_parameter_rules` already includes these.
     """
-    return [
-        (r"moe/(wi|wo)$", P("model", None, None)),
-    ]
+    return [r for r in rt1_parameter_rules() if "moe/" in r[0]]
 
 
 def _path_str(path: Tuple[Any, ...]) -> str:
@@ -92,8 +86,52 @@ def sharding_for_path(
     return NamedSharding(mesh, P())
 
 
+def spec_for_shape(spec: P, shape: Sequence[int], mesh: Mesh) -> P:
+    """`spec` with any axis entry dropped (that dim replicated) when the
+    mesh-axes product does not divide the dim.
+
+    The plan's rules are written for the large-config shapes; small
+    instantiations hit indivisible dims (EfficientNet SE bottlenecks have
+    cout as small as 6, FiLM channels as small as 8) which XLA refuses to
+    shard. Replicating such a dim is the intended degradation — the
+    tensors for which divisibility fails are precisely the ones too small
+    for sharding to matter — and keeps dense/fsdp/tp config switches from
+    crashing at placement on any model size.
+    """
+    if not spec:
+        return spec
+    dims = []
+    changed = False
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            dims.append(entry)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        ways = 1
+        for a in axes:
+            ways *= mesh.shape.get(a, 1)
+        if ways > 1 and shape[i] % ways != 0:
+            dims.append(None)
+            changed = True
+        else:
+            dims.append(entry)
+    if not changed:
+        return spec
+    while dims and dims[-1] is None:  # P(None, ..., None) ≡ P()
+        dims.pop()
+    return P(*dims)
+
+
 def shard_pytree(tree: Any, mesh: Mesh, rules: Sequence[Rule]) -> Any:
-    """A pytree of NamedShardings matching `tree`'s structure, per the rules."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, _: sharding_for_path(path, mesh, rules), tree
-    )
+    """A pytree of NamedShardings matching `tree`'s structure, per the rules
+    (indivisible dims fall back per `spec_for_shape`)."""
+
+    def one(path, leaf):
+        sh = sharding_for_path(path, mesh, rules)
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            return sh
+        safe = spec_for_shape(sh.spec, shape, mesh)
+        return sh if safe is sh.spec else NamedSharding(mesh, safe)
+
+    return jax.tree_util.tree_map_with_path(one, tree)

@@ -68,6 +68,7 @@ class PolicyEngine:
         embedder: Optional[Callable[[str], np.ndarray]] = None,
         embed_cache_size: int = 256,
         tokenizer=None,
+        plan=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -76,10 +77,20 @@ class PolicyEngine:
             raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
         self._jax = jax
         self._model = model
+        self._plan = plan
         # Device-resident params, passed to the compiled step as an
-        # argument (see swap_variables); device_put is a no-op for arrays
-        # already on device.
-        self._variables = jax.device_put(variables)
+        # argument (see swap_variables). With a `plan`
+        # (rt1_tpu/parallel/plan.py — the same declarative layout train
+        # resolves from config.parallel) each leaf lands per its plan rule
+        # on the plan's mesh, so a tensor-parallel serve mesh is a config
+        # switch; without one, device_put is a no-op for arrays already on
+        # device. Either way `swap_variables` re-places a new checkpoint
+        # with each leaf's CURRENT sharding, keeping layout stable across
+        # reloads.
+        if plan is not None:
+            self._variables = plan.place_variables(variables)
+        else:
+            self._variables = jax.device_put(variables)
         self.max_sessions = max_sessions
         self.action_mean = action_mean
         self.action_std = action_std
@@ -101,6 +112,14 @@ class PolicyEngine:
             ),
             single,
         )
+        if plan is not None:
+            # Slot state rides the same mesh as the params (replicated —
+            # slots are sessions, not data shards); mixing a mesh-placed
+            # param tree with default-device state would fail at dispatch.
+            self._state = jax.device_put(
+                self._state,
+                jax.tree.map(lambda _: plan.replicated(), self._state),
+            )
 
         # Session bookkeeping. OrderedDict doubles as the LRU order:
         # move_to_end on every act, popitem(last=False) to reclaim.
@@ -203,17 +222,28 @@ class PolicyEngine:
             return out, jax.tree.map(gate, stepped, state)
 
         n = self.max_sessions
-        var_spec = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._variables
-        )
+        # With a plan the lowered step carries each argument's mesh
+        # placement, so XLA partitions the batched step (GSPMD) instead of
+        # assuming one default device; without one the specs are placement-
+        # free, exactly as before.
+        repl = self._plan.replicated() if self._plan is not None else None
+
+        def spec_of(x):
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=getattr(x, "sharding", None)
+                if self._plan is not None else None,
+            )
+
+        var_spec = jax.tree.map(spec_of, self._variables)
         obs_spec = {
-            k: jax.ShapeDtypeStruct((n,) + tuple(shape), np.float32)
+            k: jax.ShapeDtypeStruct(
+                (n,) + tuple(shape), np.float32, sharding=repl
+            )
             for k, shape in obs_shapes.items()
         }
-        active_spec = jax.ShapeDtypeStruct((n,), np.bool_)
-        state_spec = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state
-        )
+        active_spec = jax.ShapeDtypeStruct((n,), np.bool_, sharding=repl)
+        state_spec = jax.tree.map(spec_of, self._state)
         lowered = jax.jit(batched_step, donate_argnums=(3,)).lower(
             var_spec, obs_spec, active_spec, state_spec
         )
@@ -265,6 +295,12 @@ class PolicyEngine:
         single-compile invariant survives any number of reloads. Raises
         ValueError (engine untouched, old params keep serving) on a
         structure/shape/dtype mismatch or a non-finite leaf.
+
+        Dtype validation is against the MASTER dtype: the serving tree
+        holds the f32 master params (the model's bf16 is a compute dtype —
+        the checkpoint, and therefore this tree, stays float32 under
+        mixed precision), so a standby buffer pre-cast to the compute
+        dtype is rejected rather than silently recompiled or served.
         """
         import numpy as np
         from jax import tree_util
@@ -310,10 +346,15 @@ class PolicyEngine:
             )
         # Rebuild on the SERVING treedef (a restored checkpoint may arrive
         # as plain dicts while the engine was built from a FrozenDict —
-        # the AOT executable matches treedefs exactly, not just key paths).
+        # the AOT executable matches treedefs exactly, not just key paths)
+        # and re-place each leaf with its CURRENT sharding: under a plan
+        # the swapped-in checkpoint keeps the exact mesh layout the step
+        # was compiled for, so the no-recompile guarantee holds for
+        # sharded serving too.
         treedef = jax.tree.structure(self._variables)
         device = jax.device_put(
-            jax.tree.unflatten(treedef, [leaf for _, leaf in standby])
+            jax.tree.unflatten(treedef, [leaf for _, leaf in standby]),
+            jax.tree.map(lambda x: x.sharding, self._variables),
         )
         jax.block_until_ready(device)  # pay the H2D cost off the swap
         with self._lock:

@@ -138,14 +138,35 @@ def get_config():
     config.accum_steps = 1
     config.seed = 42
 
-    # Mesh (per-process view; -1 data = all remaining local devices).
-    config.mesh = ml_collections.ConfigDict()
-    config.mesh.data = -1
-    config.mesh.model = 1
-    config.mesh.seq = 1
+    # Parallelism plan (rt1_tpu/parallel/plan.py, docs/parallelism.md): the
+    # dp × fsdp × tp × pp mesh shape plus the declarative param layout, all
+    # config-only switches — train, eval, and serve resolve this block
+    # identically. -1 dp = all remaining local devices. (Replaces the old
+    # `config.mesh` block: data→dp, model→tp, seq→sp, stage→pp; legacy
+    # configs with a `mesh` block still resolve via the same fallback.)
+    config.parallel = ml_collections.ConfigDict()
+    config.parallel.dp = -1
+    # ZeRO-3 weight sharding: batch shards over dp×fsdp, weight matrices /
+    # optimizer masters shard one dim over fsdp.
+    config.parallel.fsdp = 1
+    # Tensor parallelism (attention heads / FFN columns / MoE experts).
+    config.parallel.tp = 1
     # Pipeline stages (GPipe over the decoder's layer stack); num_layers
     # must be divisible by this.
-    config.mesh.stage = 1
+    config.parallel.pp = 1
+    # Sequence/context parallelism (ring attention).
+    config.parallel.sp = 1
+    # Pick (dp, fsdp, tp) automatically from the device count
+    # (plan.AUTO_MESH_SHAPES); pp/sp still honored as configured.
+    config.parallel.auto = False
+    # Plan-coverage strictness: True turns the "weight matrix matched no
+    # rule" warning into a hard error at step-build time.
+    config.parallel.strict = False
+    # True mixed precision: f32 master params + optimizer state, one bf16
+    # cast of params inside the jitted step for fwd/bwd (forces the model
+    # compute dtype to bfloat16; f32 softmax/CE unchanged). Off = the
+    # bit-identical pre-change f32 program.
+    config.parallel.mixed_precision = False
 
     # Observability (rt1_tpu/obs/, docs/observability.md). Defaults are
     # resolved by obs.ObsOptions.from_config, so configs without this block

@@ -23,7 +23,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rt1_tpu.parallel import MeshConfig, make_mesh
 from rt1_tpu.specs import language_table_action_space, sample_space
 from rt1_tpu.trainer import (
     create_train_state,
@@ -470,23 +469,44 @@ def train_and_evaluate(config, workdir: str):
     )
 
     writer = create_writer(workdir)
-    write_hparams(writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {})
 
     _check_clip_token_config(config)
-    mesh = make_mesh(
-        MeshConfig(
-            data=config.mesh.data,
-            model=config.mesh.model,
-            seq=config.mesh.seq,
-            stage=config.mesh.get("stage", 1),
+    # ONE plan resolution: mesh shape (dp × fsdp × tp × pp, or auto by
+    # device count) + the declarative param layout, from `config.parallel`
+    # (legacy `config.mesh` configs fall back transparently). The same
+    # resolution runs in eval/restore.py and serve, so dense/fsdp/tp/pp are
+    # config-only switches with no per-callsite spec plumbing.
+    from rt1_tpu.parallel import ShardingPlan, mixed_precision_from_config
+
+    sharding_plan = ShardingPlan.from_config(config)
+    mesh = sharding_plan.mesh
+    mixed_precision = mixed_precision_from_config(config)
+    if mixed_precision and config.model.dtype != "bfloat16":
+        from absl import logging
+
+        # True mixed precision = bf16 compute against f32 masters; the
+        # compute dtype must be bf16 for the step's cast to take effect
+        # (masters, optimizer state, and checkpoints stay f32 regardless).
+        logging.info(
+            "parallel.mixed_precision: forcing model compute dtype "
+            "bfloat16 (was %s); master params/opt state stay float32",
+            config.model.dtype,
         )
+        with config.unlocked():
+            config.model.dtype = "bfloat16"
+    # Recorded AFTER the mixed-precision dtype mutation so the hparams
+    # describe the program that actually runs (model.dtype=bfloat16 under
+    # parallel.mixed_precision, not the pre-mutation value).
+    write_hparams(
+        writer, dict(config.to_dict()) if hasattr(config, "to_dict") else {}
     )
     model, init_fn, loss_fn = build_family(config.model, mesh=mesh)
-    data_size = mesh.shape["data"]
+    data_size = sharding_plan.data_parallel_size
     if config.per_host_batch_size % data_size != 0:
         raise ValueError(
             f"per_host_batch_size={config.per_host_batch_size} must be "
-            f"divisible by the mesh data axis ({data_size} devices)"
+            f"divisible by the mesh batch axes (data x fsdp = "
+            f"{data_size} ways)"
         )
     if mesh.shape["stage"] > 1:
         accum = max(int(config.get("accum_steps", 1)), 1)
@@ -588,6 +608,9 @@ def train_and_evaluate(config, workdir: str):
         guard_grad_norm_max=res_opts.guard_grad_norm_max,
         model_health=obs_opts.model_health,
         health_group_depth=obs_opts.health_group_depth,
+        plan=sharding_plan,
+        mixed_precision=mixed_precision,
+        check_coverage=config.model.get("family", "rt1") == "rt1",
     )
     state = fns.shard_state(state)
 

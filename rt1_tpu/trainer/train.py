@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from rt1_tpu.parallel import plan as planlib
 from rt1_tpu.parallel import sharding as shardlib
 from rt1_tpu.trainer.state import TrainState
 
@@ -50,6 +51,9 @@ class TrainStepFns:
     batch_sharding: NamedSharding
     mesh: Mesh
     guarded: bool = False
+    # True when the step casts f32 master params to bf16 for fwd/bwd
+    # (optimizer state and the stored params stay f32).
+    mixed_precision: bool = False
     # Entry names of the model-health pack vector riding in the metrics
     # under obs.health.PACK_KEY (empty when model_health is off). The host
     # unpacks the fetched vector against these at log steps.
@@ -128,13 +132,16 @@ def make_train_step_fns(
     state: TrainState,
     param_rules: Optional[Sequence[shardlib.Rule]] = None,
     accum_steps: int = 1,
-    batch_axes: Tuple[str, ...] = ("data",),
+    batch_axes: Optional[Tuple[str, ...]] = None,
     donate: bool = True,
     loss_fn: Optional[Callable] = None,
     guard_nonfinite: bool = False,
     guard_grad_norm_max: float = 0.0,
     model_health: bool = False,
     health_group_depth: int = 2,
+    plan: Optional[planlib.ShardingPlan] = None,
+    mixed_precision: bool = False,
+    check_coverage: bool = True,
 ) -> TrainStepFns:
     """Build jitted train/eval steps with explicit in/out shardings.
 
@@ -168,13 +175,71 @@ def make_train_step_fns(
     as ``guard_nonfinite``: a Python-level gate, so the ``False`` path
     traces the exact pre-change program (pinned bit-identical in
     tests/test_obs_health.py).
+
+    Layout comes from the declarative ``plan`` (parallel/plan.py) — the same
+    object train, eval, and serve resolve once from ``config.parallel``.
+    ``param_rules`` remains as an explicit override; when neither is given
+    the default RT-1 plan applies. The plan's coverage check runs on
+    ``state.params`` here, so a param group the plan forgot warns loudly
+    (or raises in strict mode) at step-build time, not after silently
+    replicating for a whole run.
+
+    ``mixed_precision=True`` is TRUE mixed precision, not a compute-dtype
+    flag: the TrainState keeps float32 master params + optimizer state
+    (restore/checkpoint dtypes unchanged); inside the jitted step the f32
+    masters are cast ONCE to bfloat16 and the fwd/bwd runs on the bf16
+    copy (activations follow the model's bf16 compute dtype; softmax/CE
+    stay f32 — models/rt1.py upcasts logits before the loss). Gradient of
+    the cast is a cast back, so grads arrive f32 and the optimizer update
+    is pure f32 master arithmetic. Donation-safe: the bf16 copy is a fresh
+    buffer read from the donated input before the in-place master update.
+    With ``mixed_precision=False`` the traced program is the exact
+    pre-change program (Python-level gate, same discipline as
+    ``guard_nonfinite``/``model_health``; pinned in tests/test_plan.py).
     """
-    if param_rules is None:
-        param_rules = shardlib.rt1_parameter_rules()
+    if plan is None:
+        plan = planlib.ShardingPlan(
+            mesh=mesh,
+            rules=(
+                param_rules if param_rules is not None
+                else planlib.rt1_sharding_plan()
+            ),
+        )
+    if batch_axes is None:
+        # Batch shards over every data-parallel axis the mesh carries;
+        # meshes built before the fsdp axis existed keep ("data",).
+        batch_axes = tuple(
+            a for a in plan.batch_axes if a in mesh.shape
+        ) or ("data",)
     default_rt1_loss = loss_fn is None
     if loss_fn is None:
         def loss_fn(params, batch_stats, batch, rng, train):
             return _loss_fn(model, params, batch_stats, batch, rng, train)
+
+    if mesh.shape.get("fsdp", 1) > 1:
+        # FSDP schedule: weights are STORED sharded over `fsdp` between
+        # steps (master params + optimizer moments — the ZeRO memory win)
+        # and gathered ONCE here for fwd/bwd; the update reshards back at
+        # the step's out_shardings boundary (a reduce-scatter). One clean
+        # all-gather per step beats per-use resharding, and sidesteps the
+        # XLA:CPU partitioner miscompiles on dp×fsdp meshes (plan.py,
+        # strip_fsdp_axis). Placed INSIDE the loss closure so the bf16
+        # mixed-precision cast below lands before the gather — gathering
+        # half the bytes.
+        gather_sh = plan.gather_shardings(state.params)
+        fsdp_loss_fn = loss_fn
+
+        def loss_fn(params, batch_stats, batch, rng, train):  # noqa: F811
+            params = jax.lax.with_sharding_constraint(params, gather_sh)
+            return fsdp_loss_fn(params, batch_stats, batch, rng, train)
+
+    if mixed_precision:
+        task_loss_fn = loss_fn
+
+        def loss_fn(params, batch_stats, batch, rng, train):  # noqa: F811
+            return task_loss_fn(
+                _bf16_compute_copy(params), batch_stats, batch, rng, train
+            )
 
     health_names: Tuple[str, ...] = ()
     health_action_dims = 0
@@ -196,7 +261,14 @@ def make_train_step_fns(
             depth=health_group_depth,
             action_dims=health_action_dims,
         )
-    state_sharding = shardlib.shard_pytree(state, mesh, param_rules)
+    if check_coverage:
+        # The default rules are the RT-1 plan; callers training another
+        # family (whose param paths the plan does not describe) pass
+        # check_coverage=False rather than getting false "would silently
+        # replicate" warnings — or a strict-mode abort — for a model that
+        # is correctly replicated.
+        plan.check_coverage(state.params)
+    state_sharding = plan.tree_shardings(state)
     batch_sh = NamedSharding(mesh, P(batch_axes))
     repl = NamedSharding(mesh, P())
 
@@ -339,7 +411,19 @@ def make_train_step_fns(
         batch_sharding=batch_sh,
         mesh=mesh,
         guarded=guard_nonfinite,
+        mixed_precision=mixed_precision,
         health_names=health_names,
+    )
+
+
+def _bf16_compute_copy(tree: Any) -> Any:
+    """bf16 copy of the f32 leaves (masters untouched; non-float leaves
+    pass through). The single cast site of the mixed-precision step."""
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.asarray(x).dtype == jnp.float32
+        else x,
+        tree,
     )
 
 

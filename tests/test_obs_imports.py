@@ -76,6 +76,32 @@ stub = StubReplicaApp(replica_id=7)
 assert stub.healthz()["replica_id"] == 7
 assert stub.readyz()[0] == 200
 
+# Parallelism plan: serve processes resolve the declarative sharding plan
+# (engine param placement) without the training stack — the whole module,
+# mesh construction, rule matching, and the coverage check must work under
+# the blocker (jax is allowed; clu/tensorboard/tensorflow are not).
+import numpy as _np
+
+from rt1_tpu.parallel import (
+    MeshConfig,
+    ShardingPlan,
+    auto_mesh_shape,
+    make_mesh,
+    rt1_sharding_plan,
+)
+
+assert auto_mesh_shape(8) == (2, 2, 2)
+assert any("moe/wi" in pat for pat, _ in rt1_sharding_plan())
+plan = ShardingPlan(mesh=make_mesh(MeshConfig()))
+assert plan.coverage({"transformer": {"layer_0": {"ff": {
+    "kernel": _np.zeros((4, 4))}}}}) == []
+assert plan.coverage({"mystery": {"w": _np.zeros((4, 4))}}) == ["mystery/w"]
+assert plan.spec_for("transformer/layer_0/attn/query/kernel") is not None
+
+from rt1_tpu.eval.restore import serving_plan
+
+assert serving_plan({"parallel": {}}).mesh.devices.size == 1
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

@@ -353,3 +353,74 @@ def test_warmup_is_the_only_compile(tiny_setup):
         [("a", _obs_stream(13, 1)[0]), ("b", _obs_stream(14, 1)[0])]
     )
     assert engine.compile_count == 1
+
+
+def test_hot_swap_validates_against_master_dtype(tiny_setup):
+    """The serving tree holds f32 MASTER params even when the model
+    computes in bf16 (mixed precision is a compute-dtype cast inside the
+    step, never a storage dtype) — a standby buffer pre-cast to the
+    compute dtype must be rejected, not silently served or recompiled."""
+    import jax
+    import jax.numpy as jnp
+
+    from tests.test_rt1 import tiny_policy
+
+    model_bf16 = tiny_policy(time_sequence_length=T, dtype=jnp.bfloat16)
+    _, variables = tiny_setup  # f32 masters, as restore/checkpoint provide
+    engine = PolicyEngine(model_bf16, variables, max_sessions=2)
+    engine.act("s", _obs_stream(31, 1)[0])
+
+    cast_to_compute = jax.tree.map(
+        lambda x: np.asarray(x, np.float32).astype(jnp.bfloat16)
+        if np.issubdtype(np.asarray(x).dtype, np.floating)
+        else np.asarray(x),
+        _host_copy(variables),
+    )
+    with pytest.raises(ValueError, match="shape or dtype"):
+        engine.swap_variables(cast_to_compute)
+    assert engine.reloads == 0
+
+    # The master-dtype standby (eval/restore.load_standby_variables
+    # contract) still swaps cleanly through the same compiled step.
+    engine.swap_variables(_host_copy(variables))
+    assert engine.reloads == 1
+    assert engine.compile_count == 1
+
+
+def test_engine_restores_params_through_plan(tiny_setup):
+    """Serve-side plan consumption: the engine places params per the
+    declarative plan (1-device serve mesh for the default config — the
+    same placement as before, now mesh-aware), the AOT step still
+    compiles exactly once, and `swap_variables` re-places a standby
+    buffer with each leaf's plan sharding (no recompile)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from rt1_tpu.eval.restore import serving_plan
+
+    model, variables = tiny_setup
+    plan = serving_plan({"parallel": {"fsdp": 1, "tp": 1}})
+    assert plan.mesh.devices.size == 1
+
+    engine = PolicyEngine(model, variables, max_sessions=2, plan=plan)
+    for leaf in jax.tree_util.tree_leaves(engine._variables):
+        assert isinstance(leaf.sharding, NamedSharding)
+        assert leaf.sharding.mesh == plan.mesh
+
+    stream = _obs_stream(33, 3)
+    engine.reset("s")
+    planned = [engine.act("s", obs) for obs in stream]
+    assert engine.compile_count == 1
+
+    # Identical actions to the plain (no-plan) engine: for the default
+    # serve config the plan is placement-equivalent, byte for byte.
+    plain = PolicyEngine(model, variables, max_sessions=2)
+    plain.reset("s")
+    baseline = [plain.act("s", obs) for obs in stream]
+    for p, b in zip(planned, baseline):
+        np.testing.assert_array_equal(p["action"], b["action"])
+
+    engine.swap_variables(_host_copy(variables))
+    assert engine.reloads == 1 and engine.compile_count == 1
+    for leaf in jax.tree_util.tree_leaves(engine._variables):
+        assert leaf.sharding.mesh == plan.mesh

@@ -38,9 +38,17 @@ def test_multistep_lr_schedule():
 
 def test_mesh_shapes():
     mesh = make_mesh(MeshConfig())
-    assert mesh.shape == {"data": 8, "stage": 1, "seq": 1, "model": 1}
+    assert mesh.shape == {
+        "data": 8, "stage": 1, "fsdp": 1, "seq": 1, "model": 1
+    }
     mesh = make_mesh(MeshConfig(model=4))
-    assert mesh.shape == {"data": 2, "stage": 1, "seq": 1, "model": 4}
+    assert mesh.shape == {
+        "data": 2, "stage": 1, "fsdp": 1, "seq": 1, "model": 4
+    }
+    mesh = make_mesh(MeshConfig(fsdp=2, model=2))
+    assert mesh.shape == {
+        "data": 2, "stage": 1, "fsdp": 2, "seq": 1, "model": 2
+    }
     with pytest.raises(ValueError):
         make_mesh(MeshConfig(data=3, model=3))
 
@@ -116,13 +124,14 @@ def test_param_sharding_rules_hit_transformer():
     mesh = make_mesh(MeshConfig(data=2, model=4))
     sh = shard_pytree(variables["params"], mesh, rt1_parameter_rules())
     qk = sh["transformer"]["layer_0"]["attn"]["query"]["kernel"]
-    assert qk.spec == jax.sharding.PartitionSpec(None, "model")
-    # Non-transformer params replicated.
-    flat = jax.tree_util.tree_leaves_with_path(sh)
-    for path, s in flat:
-        pstr = "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
-        if "transformer" not in pstr:
-            assert s.spec == jax.sharding.PartitionSpec(), pstr
+    assert qk.spec == jax.sharding.PartitionSpec("fsdp", "model")
+    # The plan covers the WHOLE tree: every weight matrix (rank >= 2)
+    # matches an explicit rule — nothing falls through to silent
+    # replication (the plan-coverage guarantee, parallel/plan.py).
+    from rt1_tpu.parallel import ShardingPlan
+
+    plan = ShardingPlan(mesh=mesh)
+    assert plan.coverage(variables["params"]) == []
 
 
 def test_grad_accumulation_matches_full_batch():
