@@ -1,6 +1,6 @@
 """rt1_tpu.obs — unified observability across train, data, and serve.
 
-One subsystem, seven pieces, all optional and all cheap when off:
+One subsystem, nine pieces, all optional and all cheap when off:
 
 * :mod:`rt1_tpu.obs.trace`      — host-side Chrome-trace span recorder
   (Perfetto-loadable); train loop, feeder workers, and serve batcher emit
@@ -19,6 +19,10 @@ One subsystem, seven pieces, all optional and all cheap when off:
   partition (init/compile/step/stall/ckpt/rollback/preempt) + live MFU.
 * :mod:`rt1_tpu.obs.flops`      — XLA cost-analysis FLOPs + MFU math,
   shared by `bench.py --mode mfu` and the goodput ledger.
+* :mod:`rt1_tpu.obs.slo`        — serving SLO ledger: request outcome
+  buckets, availability, error-budget burn, `slo_summary.json`.
+* :mod:`rt1_tpu.obs.quantiles`  — the one percentile implementation
+  (exact-from-samples + histogram upper bound) every reporter shares.
 
 Import hygiene is part of the contract: this package (and everything it
 imports at module scope) must not require clu, tensorboard, or tensorflow
@@ -34,25 +38,41 @@ import dataclasses
 import os
 from typing import Optional
 
-from rt1_tpu.obs import flops, goodput, health, prometheus, recorder, steps, trace
+from rt1_tpu.obs import (
+    flops,
+    goodput,
+    health,
+    prometheus,
+    quantiles,
+    recorder,
+    slo,
+    steps,
+    trace,
+)
 from rt1_tpu.obs.goodput import GoodputLedger
 from rt1_tpu.obs.prometheus import MetricsServer
-from rt1_tpu.obs.recorder import FlightRecorder
+from rt1_tpu.obs.recorder import ExemplarRing, FlightRecorder
+from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
 from rt1_tpu.obs.steps import StepTimeline
 from rt1_tpu.obs.trace import TraceRecorder
 
 __all__ = [
+    "ExemplarRing",
     "FlightRecorder",
     "GoodputLedger",
     "MetricsServer",
     "ObsOptions",
+    "SLOLedger",
+    "SLOObjectives",
     "StepTimeline",
     "TraceRecorder",
     "flops",
     "goodput",
     "health",
     "prometheus",
+    "quantiles",
     "recorder",
+    "slo",
     "steps",
     "trace",
 ]

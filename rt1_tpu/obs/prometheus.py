@@ -108,6 +108,38 @@ class TextExposition:
         self._lines.append(f"{name}_sum {format_value(sum_value)}")
         self._lines.append(f"{name}_count {int(count)}")
 
+    def family(
+        self,
+        name: str,
+        mtype: str,
+        samples: Sequence[Tuple[Dict[str, str], float]],
+        help_text: str = "",
+    ):
+        """One metric family with LABELED samples — the fleet-aggregation
+        shape: one `# TYPE` header, one sample per replica
+        (``{replica_id="0"} 42``). Label values are escaped per the
+        exposition spec (backslash, quote, newline)."""
+        name = sanitize_name(name)
+        self._header(name, mtype, help_text)
+        for labels, value in samples:
+            rendered = ",".join(
+                f'{sanitize_name(str(k))}="{self._escape_label(str(v))}"'
+                for k, v in labels.items()
+            )
+            self._lines.append(
+                f"{name}{{{rendered}}} {format_value(value)}"
+                if rendered
+                else f"{name} {format_value(value)}"
+            )
+
+    @staticmethod
+    def _escape_label(value: str) -> str:
+        return (
+            value.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
     def render(self) -> str:
         return "\n".join(self._lines) + "\n"
 
@@ -140,6 +172,13 @@ def render_serve_snapshot(
 ) -> str:
     """ServeMetrics JSON snapshot -> Prometheus text, one source of truth."""
     exp = TextExposition()
+    _render_serve_into(exp, snapshot, prefix)
+    return exp.render()
+
+
+def _render_serve_into(
+    exp: TextExposition, snapshot: Dict[str, Any], prefix: str
+) -> None:
     consumed = set()
     for key, help_text in _SERVE_COUNTERS.items():
         if key in snapshot:
@@ -161,10 +200,97 @@ def render_serve_snapshot(
         value = snapshot[key]
         if isinstance(value, bool) or not isinstance(value, (int, float)):
             continue
-        name = prefix + key
-        if key == "uptime_s":
-            name = prefix + "uptime_seconds"
-        exp.gauge(name, value)
+        exp.gauge(prefix + _gauge_suffix(key), value)
+
+
+def _gauge_suffix(key: str) -> str:
+    """Snapshot key -> metric-name suffix (units spelled out per the
+    Prometheus naming convention)."""
+    return "uptime_seconds" if key == "uptime_s" else key
+
+
+# Replica snapshot fields fanned into the router's aggregated /metrics,
+# one labeled family each: rt1_serve_replica_<field>{replica_id="N"}.
+# Curated (not "every numeric key") so the fleet scrape stays a stable
+# contract — per-replica occupancy, compiles, reloads, queue depth, the
+# request counters, and the latency quantiles.
+_FLEET_REPLICA_FIELDS = {
+    "requests_total": ("counter", "Requests served by this replica."),
+    "errors_total": ("counter", "Error responses from this replica."),
+    "rejected_total": ("counter", "Requests shed by this replica (503)."),
+    "reloads_total": ("counter", "Checkpoint hot-swaps on this replica."),
+    "batches_total": ("counter", "Batched device steps on this replica."),
+    "active_sessions": ("gauge", "Live session slots in use."),
+    "compile_count": (
+        "gauge",
+        "AOT compiles this replica lifetime (invariant: 1).",
+    ),
+    "queue_depth": ("gauge", "Micro-batcher queue depth at last batch."),
+    "mean_batch_occupancy": ("gauge", "Mean batch fill."),
+    "max_batch_occupancy": ("gauge", "Max batch fill."),
+    "latency_p50_ms": ("gauge", "Replica-local request p50 (ms)."),
+    "latency_p99_ms": ("gauge", "Replica-local request p99 (ms)."),
+    "requests_per_sec": ("gauge", "Replica-local request rate."),
+    "ready": ("gauge", "1 when the replica reports ready."),
+    "reloading": ("gauge", "1 while a hot-swap is in progress."),
+    "draining": ("gauge", "1 while draining after SIGTERM."),
+    "session_evictions": ("gauge", "LRU slot reclaims (oversubscription)."),
+    "slow_exemplars": ("gauge", "Slow-request exemplars retained."),
+    "uptime_s": ("gauge", "Replica process uptime (seconds)."),
+}
+
+
+def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
+    """Every family name the aggregated fleet exposition can emit (the
+    naming-contract test iterates this)."""
+    names = [prefix + "replica_up"]
+    for key in _FLEET_REPLICA_FIELDS:
+        names.append(prefix + "replica_" + _gauge_suffix(key))
+    return names
+
+
+def render_fleet_snapshot(
+    router_snapshot: Dict[str, Any],
+    replicas: Dict[Any, Optional[Dict[str, Any]]],
+    prefix: str = "rt1_serve_",
+) -> str:
+    """Router snapshot + per-replica snapshots -> ONE exposition body.
+
+    The router's own families render exactly as `render_serve_snapshot`
+    (same names, so a single-replica dashboard keeps working against a
+    fleet); each replica's curated fields follow as labeled families with
+    a ``replica_id`` label. A replica whose `/metrics` probe failed
+    (value None) appears only in ``replica_up`` as 0 — absence of data is
+    itself a scraped fact, not a silent gap.
+    """
+    exp = TextExposition()
+    _render_serve_into(exp, router_snapshot, prefix)
+    up = [
+        ({"replica_id": str(rid)}, 0.0 if snap is None else 1.0)
+        for rid, snap in sorted(replicas.items(), key=lambda kv: str(kv[0]))
+    ]
+    if up:
+        exp.family(
+            prefix + "replica_up",
+            "gauge",
+            up,
+            "1 when the replica's /metrics answered the fan-out probe.",
+        )
+    for key, (mtype, help_text) in _FLEET_REPLICA_FIELDS.items():
+        samples = [
+            ({"replica_id": str(rid)}, snap[key])
+            for rid, snap in sorted(
+                replicas.items(), key=lambda kv: str(kv[0])
+            )
+            if snap is not None and isinstance(snap.get(key), (int, float))
+            and not isinstance(snap.get(key), bool)
+        ]
+        if not samples:
+            continue
+        exp.family(
+            prefix + "replica_" + _gauge_suffix(key), mtype, samples,
+            help_text,
+        )
     return exp.render()
 
 

@@ -54,6 +54,14 @@ def _now_us() -> float:
     return (time.perf_counter() - _EPOCH) * 1e6
 
 
+def now_us() -> float:
+    """Current time on the trace clock (µs since the process epoch) —
+    capture one of these per phase boundary, then emit with `complete`.
+    Valid whether or not a recorder is installed, so phase stamping can
+    be unconditional while emission stays gated."""
+    return _now_us()
+
+
 class _NullSpan:
     """Shared no-op context manager: the disabled-tracer fast path."""
 
@@ -144,6 +152,14 @@ class TraceRecorder:
 
     def span(self, name: str, **args) -> _Span:
         return _Span(self, name, args or None)
+
+    def complete(self, name: str, ts_us: float, dur_us: float, **args) -> None:
+        """Record a complete event from explicit timestamps (`now_us()`
+        clock). This is how cross-thread phases become spans: `span()`
+        times the current thread's with-block, but a request's queue wait
+        starts on an HTTP handler thread and ends on the batcher loop —
+        the waiter stamps both ends and emits the span after the fact."""
+        self._complete(name, ts_us, max(dur_us, 0.0), args or None)
 
     def instant(self, name: str, **args) -> None:
         """Point-in-time marker (thread-scoped)."""
@@ -273,6 +289,15 @@ def instant(name: str, **args) -> None:
     t = _tracer
     if t is not None:
         t.instant(name, **args)
+
+
+def complete(name: str, ts_us: float, dur_us: float, **args) -> None:
+    """Record a complete event from explicit `now_us()` timestamps
+    (no-op when disabled) — the cross-thread span path; see
+    `TraceRecorder.complete`."""
+    t = _tracer
+    if t is not None:
+        t.complete(name, ts_us, dur_us, **args)
 
 
 def counter(name: str, value: float = 0.0, **series) -> None:

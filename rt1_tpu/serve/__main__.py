@@ -131,6 +131,8 @@ def main(argv):
         request_timeout_s=FLAGS.request_timeout_s,
         replica_id=FLAGS.replica_id,
         reload_fn=reload_fn,
+        slow_threshold_ms=FLAGS.slow_threshold_ms,
+        exemplar_path=FLAGS.exemplar_path or None,
     )
     app.start(warmup=True)
     if FLAGS.watch_checkpoints_s > 0 and not FLAGS.random_init:
@@ -207,6 +209,13 @@ if __name__ == "__main__":
         "allow_embedder_mismatch", False,
         "Serve even if the checkpoint's data manifest records a different "
         "instruction embedder.")
+    flags.DEFINE_float(
+        "slow_threshold_ms", 0.0,
+        "Keep requests at least this slow in the exemplar ring "
+        "(GET /slow_requests); 0 keeps the most recent window of all.")
+    flags.DEFINE_string(
+        "exemplar_path", "",
+        "Dump the slow-request exemplar ring here (JSONL) on drain.")
     flags.DEFINE_bool("verbose", False, "Log per-request lines.")
     flags.mark_flags_as_required(["config"])
     sys.exit(absl_app.run(main))

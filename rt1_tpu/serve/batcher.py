@@ -56,6 +56,7 @@ class MicroBatcher:
         max_queue: int = 64,
         batch_key: Optional[Callable[[Any], Any]] = None,
         metrics: Optional[Any] = None,
+        on_batch: Optional[Callable[[List[Any]], None]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -67,6 +68,11 @@ class MicroBatcher:
         self._max_queue = max_queue
         self._batch_key = batch_key
         self._metrics = metrics
+        # Called on the loop thread with each formed batch's items before
+        # dispatch — the serve app stamps per-request "popped into a
+        # batch" timestamps here (queue wait ends, batch formation
+        # begins). Exceptions are the caller's bug; keep it trivial.
+        self._on_batch = on_batch
         self._pending: collections.deque = collections.deque()
         self._draining = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -187,6 +193,8 @@ class MicroBatcher:
             batch = self._take_batch()
             if not batch:
                 continue
+            if self._on_batch is not None:
+                self._on_batch([item for item, _ in batch])
             if self._metrics is not None:
                 self._metrics.observe_batch(
                     len(batch), queued=len(self._pending)

@@ -44,6 +44,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from rt1_tpu.obs import trace as obs_trace
+
 EPS = np.finfo(np.float32).eps
 EMBEDDING_DIM = 512
 
@@ -483,13 +485,17 @@ class PolicyEngine:
         # become per-item error results, not a poisoned batch.
         resolved: List[Optional[Dict[str, np.ndarray]]] = []
         errors: List[Optional[Exception]] = []
-        for sid, obs in items:
-            try:
-                resolved.append(self._resolve_obs(obs))
-                errors.append(None)
-            except Exception as exc:  # noqa: BLE001 - isolated per item
-                resolved.append(None)
-                errors.append(exc)
+        # obs: nested inside the server's device_step span — an embedder
+        # cache miss (full text-tower forward) shows up as engine_resolve
+        # dwarfing engine_dispatch, instead of being booked as device time.
+        with obs_trace.span("engine_resolve", batch=len(items)):
+            for sid, obs in items:
+                try:
+                    resolved.append(self._resolve_obs(obs))
+                    errors.append(None)
+                except Exception as exc:  # noqa: BLE001 - isolated per item
+                    resolved.append(None)
+                    errors.append(exc)
 
         good = [
             (i, sid, obs)
@@ -562,12 +568,18 @@ class PolicyEngine:
                             batch_obs[k][slot] = v
                         active[slot] = True
 
-                    out, self._state = self._compiled(
-                        self._variables, batch_obs, active, self._state
-                    )
+                    # obs: dispatch + the blocking device→host fetch of
+                    # the outputs (jax dispatch is async; np.asarray is
+                    # where the wall time of the XLA step actually lands).
+                    with obs_trace.span(
+                        "engine_dispatch", active=len(kept)
+                    ):
+                        out, self._state = self._compiled(
+                            self._variables, batch_obs, active, self._state
+                        )
 
-                    actions = np.asarray(out["action"])
-                    tokens = np.asarray(out["action_tokens"])
+                        actions = np.asarray(out["action"])
+                        tokens = np.asarray(out["action_tokens"])
                     terminate = (
                         np.asarray(out["terminate_episode"])
                         if "terminate_episode" in out

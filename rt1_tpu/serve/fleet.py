@@ -72,6 +72,7 @@ class FleetSupervisor:
         max_restarts: int = 50,
         log_dir: Optional[str] = None,
         extra_env: Optional[Dict[str, str]] = None,
+        exemplar_scrape_interval_s: float = 2.0,
     ):
         self.router = router
         self._spawn_argv_fn = spawn_argv_fn
@@ -86,6 +87,7 @@ class FleetSupervisor:
         self.extra_env = extra_env
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._scrape_thread: Optional[threading.Thread] = None
         # Chaos bookkeeping (summary + determinism evidence). Mutated only
         # on the single supervisor thread; readers (summary, tests)
         # tolerate a stale int — no lock needed or implied.
@@ -95,6 +97,16 @@ class FleetSupervisor:
         self.hangs_injected = 0
         self.reloads_injected = 0
         self.restarts_total = 0
+        # Slow-request exemplars, scraped from each live replica's
+        # GET /slow_requests on a slow cadence. A SIGKILLed replica never
+        # runs its drain-time dump, so the supervisor's last scrape is
+        # the only copy of "what the victim was serving when it died" —
+        # the serve-side flight-recorder semantics the post-mortem needs.
+        self.exemplar_scrape_interval_s = exemplar_scrape_interval_s
+        # Written by the scrape thread, read by slow_request_evidence()
+        # (fleet main's final status line, while the scraper still runs).
+        self._exemplar_lock = threading.Lock()
+        self.last_exemplars: Dict[int, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------ spawning
 
@@ -168,6 +180,16 @@ class FleetSupervisor:
             target=self._supervise, name="rt1-fleet-supervisor", daemon=True
         )
         self._thread.start()
+        if self.exemplar_scrape_interval_s > 0:
+            # Own thread: a hung replica makes each /slow_requests probe
+            # eat its full timeout, which on the supervision thread would
+            # delay the very death detection that makes the scrape matter.
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop,
+                name="rt1-fleet-exemplar-scrape",
+                daemon=True,
+            )
+            self._scrape_thread.start()
 
     def wait_all_ready(self) -> None:
         """Block until every replica passes warm-up (ready-line + /readyz),
@@ -291,6 +313,43 @@ class FleetSupervisor:
             if replica.state == READY:
                 self.router.set_state(replica.id, NOTREADY)
 
+    def _scrape_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._scrape_exemplars()
+            except Exception as exc:  # noqa: BLE001 - keep scraping
+                print(
+                    json.dumps(
+                        {"status": "exemplar_scrape_error", "error": str(exc)}
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+            self._stop.wait(self.exemplar_scrape_interval_s)
+
+    def _scrape_exemplars(self) -> None:
+        """Pull each live replica's slow-request ring into supervisor
+        memory, so the exemplars survive a SIGKILL/crash of the replica.
+        Keyed by replica id; a respawned replica's fresh (empty) ring only
+        replaces the dead generation's scrape once it has entries —
+        "nothing recorded yet" must not erase the crash evidence."""
+        for replica in self.router.replicas():
+            if replica.url is None or replica.state == DEAD:
+                continue
+            status, body = get_json(
+                replica.url + "/slow_requests", timeout=self.probe_timeout_s
+            )
+            if status != 200 or not isinstance(body, dict):
+                continue
+            with self._exemplar_lock:
+                if (
+                    body.get("retained")
+                    or replica.id not in self.last_exemplars
+                ):
+                    body["scraped_at"] = time.time()
+                    body["generation"] = replica.restarts
+                    self.last_exemplars[replica.id] = body
+
     def _respawn(self, replica: Replica) -> None:
         if self.restarts_total >= self.max_restarts:
             return  # crash-looping fleet: stop burning the host
@@ -336,6 +395,8 @@ class FleetSupervisor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=timeout)
         for replica in self.router.replicas():
             proc = replica.proc
             if proc is None or proc.poll() is not None:
@@ -364,12 +425,30 @@ class FleetSupervisor:
             ),
         }
 
+    def slow_request_evidence(
+        self, per_replica: int = 8
+    ) -> Dict[str, Any]:
+        """The last-scraped exemplars, trimmed to the `per_replica` most
+        recent records each — the fleet's crash-surviving slow-request
+        evidence for the final status line / post-mortem."""
+        out = {}
+        with self._exemplar_lock:
+            snapshot = sorted(self.last_exemplars.items())
+        for rid, scrape in snapshot:
+            records = scrape.get("slow_requests", [])
+            out[str(rid)] = {
+                **{k: v for k, v in scrape.items() if k != "slow_requests"},
+                "slow_requests": records[-per_replica:],
+            }
+        return out
+
 
 # -------------------------------------------------------------- entry point
 
 
 def replica_argv_builder(args) -> Callable[[int], List[str]]:
     """argv factory for one replica — the stub or the real server."""
+    slow_threshold = getattr(args, "slow_threshold_ms", 0.0)
     if args.stub:
         def build(replica_id: int) -> List[str]:
             return [
@@ -378,6 +457,7 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
                 "--replica_id", str(replica_id),
                 "--max_sessions", str(args.max_sessions),
                 "--act_delay_s", str(args.stub_act_delay_s),
+                "--slow_threshold_ms", str(slow_threshold),
             ]
         return build
 
@@ -389,6 +469,7 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
             "--replica_id", str(replica_id),
             "--max_sessions", str(args.max_sessions),
             "--embedder", args.embedder,
+            "--slow_threshold_ms", str(slow_threshold),
         ]
         if args.random_init:
             argv.append("--random_init")
@@ -421,6 +502,19 @@ def main(argv=None) -> int:
     parser.add_argument("--max_sessions", type=int, default=8)
     parser.add_argument("--embedder", default="hash")
     parser.add_argument("--stub_act_delay_s", type=float, default=0.0)
+    parser.add_argument(
+        "--slow_threshold_ms", type=float, default=0.0,
+        help="Replica exemplar-ring threshold, forwarded to every "
+             "replica (0 keeps the most recent window of all requests).")
+    parser.add_argument(
+        "--slo_availability", type=float, default=0.99,
+        help="Router SLO: fraction of requests that must be ok.")
+    parser.add_argument(
+        "--slo_p50_ms", type=float, default=250.0,
+        help="Router SLO: answered-request p50 objective (ms).")
+    parser.add_argument(
+        "--slo_p99_ms", type=float, default=2500.0,
+        help="Router SLO: answered-request p99 objective (ms).")
     parser.add_argument("--faults", default="",
                         help="Chaos plan, e.g. 'replica_kill@1,"
                              "serve_reload@2' (RT1_FAULTS appended).")
@@ -441,9 +535,18 @@ def main(argv=None) -> int:
 
     faults.install_from(args.faults)
 
+    from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+
     router = Router(
         replica_timeout_s=args.replica_timeout_s,
         max_failovers=args.max_failovers,
+        slo=SLOLedger(
+            SLOObjectives(
+                availability=args.slo_availability,
+                latency_p50_ms=args.slo_p50_ms,
+                latency_p99_ms=args.slo_p99_ms,
+            )
+        ),
     )
     supervisor = FleetSupervisor(
         router,
@@ -494,6 +597,11 @@ def main(argv=None) -> int:
             "fleet": router.fleet_status(probe_metrics=True),
             "chaos": supervisor.summary(),
             "router_metrics": router.metrics_snapshot(),
+            # The fleet's own judgement + crash-surviving exemplars, so a
+            # chaos driver (loadgen) can fold the server-side SLO story
+            # into its BENCH record without re-deriving it client-side.
+            "slo": router.slo.summary(),
+            "slow_requests": supervisor.slow_request_evidence(),
         }
         supervisor.stop()
         print(json.dumps(final), flush=True)

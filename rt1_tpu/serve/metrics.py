@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from rt1_tpu.obs.quantiles import bucket_quantile
+
 # Geometric-ish bucket upper bounds in seconds, 0.1 ms .. 30 s. Wide enough
 # for a tiny-CPU smoke model (sub-ms) and a cold remote-TPU dispatch alike.
 DEFAULT_BUCKETS = (
@@ -44,16 +46,12 @@ class LatencyHistogram:
 
     def quantile(self, q: float) -> float:
         """Upper bound of the bucket containing the q-quantile (0 if empty).
-        The overflow bucket reports the observed max."""
-        if self.count == 0:
-            return 0.0
-        rank = q * self.count
-        cumulative = 0
-        for i, upper in enumerate(self.buckets):
-            cumulative += self.counts[i]
-            if cumulative >= rank:
-                return upper
-        return self.max
+        The overflow bucket reports the observed max. Shared estimator:
+        `rt1_tpu/obs/quantiles.py` (loadgen and the SLO ledger use the
+        exact-sample twin from the same module)."""
+        return bucket_quantile(
+            self.buckets, self.counts, self.count, self.max, q
+        )
 
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0

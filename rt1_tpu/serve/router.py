@@ -28,6 +28,22 @@ fleet behaviors on top:
   in-place), then wait for `/readyz` to report ready again before touching
   the next replica. At most one replica is ever in the not-ready drain
   state, so fleet capacity never dips by more than one engine.
+* **Request tracing.** Every `/act` resolves one request id (client
+  `X-RT1-Request-Id` header honored, else minted — `serve/reqtrace.py`),
+  wraps the route in a `router_route` span carrying that id, and forwards
+  the id to the replica in the same header, so the router span, the
+  replica's `replica_act`/`batch_wait`/`device_step` spans, and the
+  response's `request_id` all correlate in one Perfetto timeline.
+* **SLO ledger.** Every routed request lands in one outcome class
+  (ok / restarted / rejected / failed — `rt1_tpu/obs/slo.py`); the
+  ledger's availability / error-budget-burn gauges ride `/metrics` as
+  ``rt1_serve_slo_*`` and `GET /slo` returns the full judgement.
+* **Fleet metrics aggregation.** The router's `/metrics` fans out to
+  every live replica's `/metrics` and merges the snapshots into ONE
+  scrape target: JSON carries a ``replicas`` map keyed by replica id,
+  Prometheus text renders each curated replica field as a labeled family
+  (``rt1_serve_replica_*{replica_id="N"}``). `GET /fleet/slow_requests`
+  fans out the slow-request exemplar rings the same way.
 
 The router carries no model code — stdlib HTTP + `ServeMetrics` only — so
 it stays featherweight next to N jax-heavy replicas (pinned by
@@ -48,6 +64,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from rt1_tpu.obs import prometheus as obs_prometheus
+from rt1_tpu.obs import trace as obs_trace
+from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+from rt1_tpu.serve import reqtrace
 from rt1_tpu.serve.metrics import ServeMetrics
 
 # Replica lifecycle as the router sees it. STARTING covers spawn ->
@@ -61,14 +80,18 @@ DEAD = "dead"
 
 
 def post_json(
-    url: str, payload: Dict[str, Any], timeout: float
+    url: str,
+    payload: Dict[str, Any],
+    timeout: float,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Tuple[int, Dict[str, Any]]:
     """POST JSON -> (status, body); status 0 = transport failure (the
-    failover trigger: refused, reset, timeout, or a non-JSON corpse)."""
+    failover trigger: refused, reset, timeout, or a non-JSON corpse).
+    `headers` rides extra metadata (the request-id propagation hop)."""
     req = urllib.request.Request(
         url,
         data=json.dumps(payload).encode("utf-8"),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
         method="POST",
     )
     try:
@@ -129,6 +152,8 @@ class Router:
         reload_timeout_s: float = 300.0,
         max_tracked_sessions: int = 8192,
         metrics: Optional[ServeMetrics] = None,
+        slo: Optional[SLOLedger] = None,
+        metrics_probe_timeout_s: float = 3.0,
     ):
         self._lock = threading.RLock()
         self._replicas: Dict[int, Replica] = {}
@@ -147,6 +172,10 @@ class Router:
         self.max_failovers = max_failovers
         self.reload_timeout_s = reload_timeout_s
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        # The fleet's judge: every routed /act lands in exactly one
+        # outcome class; gauges ride /metrics, GET /slo has the verdict.
+        self.slo = slo if slo is not None else SLOLedger(SLOObjectives())
+        self.metrics_probe_timeout_s = metrics_probe_timeout_s
         self.draining = False
 
     # ------------------------------------------------------------ registry
@@ -235,15 +264,50 @@ class Router:
 
     # ------------------------------------------------------------- routing
 
-    def route_act(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    def route_act(
+        self,
+        payload: Dict[str, Any],
+        headers=None,
+    ) -> Tuple[int, Dict[str, Any]]:
         """Forward one /act with affinity + bounded failover. A replica
         death mid-request becomes `restarted: true` on the retried 200,
-        never a client-visible 5xx."""
+        never a client-visible 5xx.
+
+        One request id spans the whole route: resolved here (client header
+        / payload / minted), carried by the `router_route` span, forwarded
+        to the replica in the `X-RT1-Request-Id` header, and echoed in the
+        response body — including error bodies, so a client can quote the
+        id of the exact request that was shed. Every exit classifies into
+        the SLO ledger with the router-side wall time.
+        """
+        request_id = reqtrace.request_id_from(headers, payload)
+        t0 = time.perf_counter()
+        with obs_trace.span(
+            "router_route",
+            request_id=request_id,
+            session=payload.get("session_id"),
+        ):
+            status, body = self._route_act_inner(payload, request_id)
+        body.setdefault("request_id", request_id)
+        elapsed = time.perf_counter() - t0
+        if status == 200 and "error" not in body:
+            outcome = "restarted" if body.get("restarted") else "ok"
+        elif status == 503:
+            outcome = "rejected"
+        else:
+            outcome = "failed"
+        self.slo.observe(outcome, elapsed)
+        return status, body
+
+    def _route_act_inner(
+        self, payload: Dict[str, Any], request_id: str
+    ) -> Tuple[int, Dict[str, Any]]:
         session_id = payload.get("session_id")
         if not isinstance(session_id, str) or not session_id:
             return 400, {"error": "'session_id' must be a non-empty string"}
         if self.draining:
             return 503, {"error": "draining"}
+        fwd_headers = {reqtrace.REQUEST_ID_HEADER: request_id}
         last_error = "no ready replicas"
         for _ in range(self.max_failovers + 1):
             replica = self._replica_for(session_id)
@@ -259,7 +323,10 @@ class Router:
                 self._orphan_session(session_id, replica.id)
                 continue
             status, body = post_json(
-                target_url + "/act", payload, self.replica_timeout_s
+                target_url + "/act",
+                payload,
+                self.replica_timeout_s,
+                headers=fwd_headers,
             )
             if status == 0:
                 # Transport failure. Dead and merely-slow look identical
@@ -407,10 +474,74 @@ class Router:
             }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
-        return self.metrics.snapshot(**self._gauges())
+        """Router-own counters + fleet gauges + the SLO ledger's
+        ``slo_*`` gauges (exposed as ``rt1_serve_slo_*`` in text)."""
+        return self.metrics.snapshot(**self._gauges(), **self.slo.gauges())
 
     def metrics_prometheus(self) -> str:
-        return self.metrics.prometheus_text(**self._gauges())
+        return self.metrics.prometheus_text(
+            **self._gauges(), **self.slo.gauges()
+        )
+
+    # -------------------------------------------------- fleet aggregation
+
+    def _fan_out_get(self, path: str) -> Dict[int, Optional[Dict[str, Any]]]:
+        """Probe `path` on every live replica CONCURRENTLY (one thread
+        each): the scrape path must cost ~one probe timeout total, not
+        replicas x timeout — a hung replica during an incident is exactly
+        when the aggregated view matters most. {replica_id: body | None};
+        None (dead, booting, probe failed) is preserved: the aggregated
+        view reports absence (``replica_up 0``) instead of silently
+        narrowing the fleet."""
+        replicas = sorted(self.replicas(), key=lambda r: r.id)
+        out: Dict[int, Optional[Dict[str, Any]]] = {
+            r.id: None for r in replicas
+        }
+
+        def probe(replica: Replica) -> None:
+            status, body = get_json(
+                replica.url + path, timeout=self.metrics_probe_timeout_s
+            )
+            if status == 200 and isinstance(body, dict):
+                out[replica.id] = body  # distinct key per thread: no lock
+
+        threads = [
+            threading.Thread(target=probe, args=(r,), daemon=True)
+            for r in replicas
+            if r.url is not None and r.state != DEAD
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.metrics_probe_timeout_s + 1.0
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        return out
+
+    def probe_replica_metrics(self) -> Dict[int, Optional[Dict[str, Any]]]:
+        """Fan out to every registered replica's `/metrics` (JSON)."""
+        return self._fan_out_get("/metrics")
+
+    def fleet_metrics_snapshot(self) -> Dict[str, Any]:
+        """The aggregated JSON view: the router's own snapshot (incl. SLO
+        gauges) plus every replica's full snapshot under ``replicas``."""
+        replicas = self.probe_replica_metrics()
+        return {
+            **self.metrics_snapshot(),
+            "replicas": {str(rid): snap for rid, snap in replicas.items()},
+        }
+
+    def fleet_metrics_prometheus(self) -> str:
+        """One exposition body for the whole fleet: router families at
+        their usual names + ``rt1_serve_replica_*{replica_id="N"}``."""
+        return obs_prometheus.render_fleet_snapshot(
+            self.metrics_snapshot(), self.probe_replica_metrics()
+        )
+
+    def fleet_slow_requests(self) -> Dict[str, Any]:
+        """Fan out `/slow_requests`: every live replica's exemplar ring,
+        keyed by replica id (None for a replica that could not answer)."""
+        probed = self._fan_out_get("/slow_requests")
+        return {"replicas": {str(rid): body for rid, body in probed.items()}}
 
     def fleet_status(self, probe_metrics: bool = True) -> Dict[str, Any]:
         """Per-replica table for /fleet/status; with `probe_metrics`, each
@@ -498,15 +629,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(code, payload)
         elif self.path == "/fleet/status":
             self._reply(200, self.router.fleet_status())
+        elif self.path == "/fleet/slow_requests":
+            self._reply(200, self.router.fleet_slow_requests())
+        elif self.path == "/slo":
+            self._reply(200, self.router.slo.summary())
         elif self.path == "/metrics":
+            # ONE scrape target for the whole fleet: the router's own
+            # families plus every replica's curated fields, fanned out on
+            # each scrape (same content negotiation as a lone replica).
             if obs_prometheus.accepts_text(self.headers.get("Accept")):
                 self._reply_text(
                     200,
-                    self.router.metrics_prometheus(),
+                    self.router.fleet_metrics_prometheus(),
                     obs_prometheus.CONTENT_TYPE,
                 )
             else:
-                self._reply(200, self.router.metrics_snapshot())
+                self._reply(200, self.router.fleet_metrics_snapshot())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -522,7 +660,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         t0 = time.perf_counter()
         if self.path == "/act":
-            status, body = self.router.route_act(payload)
+            status, body = self.router.route_act(payload, self.headers)
             if status == 503:
                 # Shed load (no ready replicas / failover budget) is the
                 # rejected counter, not errors_total — same split the

@@ -31,6 +31,17 @@ Endpoints (all JSON):
                   `Accept: text/plain` (or openmetrics) the same numbers in
                   Prometheus exposition format (rt1_tpu/obs/prometheus.py);
                   includes the `draining` and `ready` gauges.
+* `GET /slow_requests` the bounded slow-request exemplar ring: request
+                  ids + per-phase breakdowns of every request past the
+                  slow threshold (serve/reqtrace.py; dumped to JSONL on
+                  drain when `exemplar_path` is configured).
+
+Request tracing: every `/act` resolves a request id (client/router
+`X-RT1-Request-Id` header, else minted) that is echoed as `request_id`
+in the response, stamped through admission -> queue -> batch -> device ->
+serialization (`serve/reqtrace.py`), emitted as linked `replica_act` /
+`batch_wait` / `device_step` spans on the shared obs timeline, and —
+with `"debug": true` in the payload — returned as a `phases` breakdown.
 
 Backpressure maps to HTTP: queue full -> 503 `busy`, draining -> 503
 `draining`. `install_signal_handlers` wires SIGTERM/SIGINT to a graceful
@@ -54,6 +65,8 @@ import numpy as np
 
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
+from rt1_tpu.obs.recorder import ExemplarRing
+from rt1_tpu.serve import reqtrace
 from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
 from rt1_tpu.serve.engine import PolicyEngine, SessionError
 from rt1_tpu.serve.metrics import ServeMetrics
@@ -133,6 +146,9 @@ class ServeApp:
         metrics: Optional[ServeMetrics] = None,
         replica_id: int = 0,
         reload_fn=None,
+        slow_threshold_ms: float = 0.0,
+        slow_capacity: int = 128,
+        exemplar_path: Optional[str] = None,
     ):
         self.engine = engine
         self.image_shape = tuple(image_shape)
@@ -140,6 +156,14 @@ class ServeApp:
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.request_timeout_s = request_timeout_s
         self.replica_id = replica_id
+        # Slow-request exemplar ring — the serve-side flight recorder:
+        # request id + phase breakdown for every request past the
+        # threshold (0 = all, ring-bounded), served on GET /slow_requests
+        # and dumped to `exemplar_path` on drain/SIGTERM.
+        self.exemplars = ExemplarRing(
+            capacity=slow_capacity, threshold_ms=slow_threshold_ms
+        )
+        self.exemplar_path = exemplar_path
         # reload_fn(step|None) -> (variables, checkpoint_step): the standby
         # restore path behind POST /reload (eval/restore.py
         # load_standby_variables closed over config+workdir).
@@ -169,14 +193,34 @@ class ServeApp:
             max_queue=max_queue,
             batch_key=lambda item: item[0],  # one in-flight step per session
             metrics=self.metrics,
+            on_batch=self._mark_batch_formed,
         )
+
+    @staticmethod
+    def _mark_batch_formed(items) -> None:
+        """Batcher-loop hook: these requests just left the queue (queue
+        wait ends, batch formation begins)."""
+        now = obs_trace.now_us()
+        for _, _, phases in items:
+            phases.t_formed = now
 
     def _process(self, items):
         t0 = time.perf_counter()
-        # obs: span on the batcher's executor thread — the serve leg of the
-        # shared host timeline (train loop + feeder workers + this).
-        with obs_trace.span("serve_batch_step", batch=len(items)):
-            results = self.engine.act_batch(items)
+        now = obs_trace.now_us()
+        for _, _, phases in items:
+            phases.t_device0 = now
+        # obs: `device_step` span on the batcher's executor thread — the
+        # serve leg of the shared host timeline, tagged with every rider's
+        # request id so Perfetto links it to router_route/replica_act.
+        with reqtrace.device_step_span(
+            len(items), (ph.request_id for _, _, ph in items)
+        ):
+            results = self.engine.act_batch(
+                [(sid, obs) for sid, obs, _ in items]
+            )
+        now = obs_trace.now_us()
+        for _, _, phases in items:
+            phases.t_device1 = now
         self.metrics.observe_step(time.perf_counter() - t0)
         return results
 
@@ -191,16 +235,27 @@ class ServeApp:
             self.engine.warmup(self.image_shape, self.embed_dim)
         self.ready = True
 
-    def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
-        """Blocking bridge used by HTTP handler threads."""
+    def act(
+        self,
+        session_id: str,
+        obs: Dict[str, Any],
+        phases: Optional[reqtrace.RequestPhases] = None,
+    ) -> Dict[str, Any]:
+        """Blocking bridge used by HTTP handler threads. `phases` rides
+        the batcher item so every boundary thread stamps the same ledger
+        (a direct caller without one still gets a fresh ledger — the
+        batcher hooks unconditionally dereference it)."""
+        if phases is None:
+            phases = reqtrace.RequestPhases()
         with self._admit_lock:
             # Atomic with drain()'s flag flip: once a request passes this
             # check it is scheduled on the loop ahead of batcher.drain(),
             # so SIGTERM flushes it — admitted work is never answered 503.
             if self.draining:
                 raise DrainingError("draining; not accepting requests")
+            phases.t_enqueue = obs_trace.now_us()
             future = asyncio.run_coroutine_threadsafe(
-                self.batcher.submit((session_id, obs)), self._loop
+                self.batcher.submit((session_id, obs, phases)), self._loop
             )
         try:
             result = future.result(timeout=self.request_timeout_s)
@@ -234,6 +289,11 @@ class ServeApp:
             ).result(timeout=timeout)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=timeout)
+        if self.exemplar_path and len(self.exemplars):
+            try:
+                self.exemplars.dump(self.exemplar_path, reason="drain")
+            except OSError:
+                pass  # exit path: a full disk must not block the drain
 
     def reload(self, step: Optional[int] = None) -> Dict[str, Any]:
         """Zero-downtime checkpoint hot-swap: restore into a standby host
@@ -304,6 +364,7 @@ class ServeApp:
             # Nonzero while serving steady traffic = more live sessions
             # than slots; their context windows are thrashing to zero.
             "session_evictions": self.engine.evictions,
+            "slow_exemplars": len(self.exemplars),
             # 1 while the batcher drains after SIGTERM (scrapers see the
             # shutdown even if their LB already stopped routing /readyz).
             "draining": int(self.draining),
@@ -377,6 +438,17 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._reply(200, self.app.metrics_snapshot())
+        elif self.path == "/slow_requests":
+            # The live exemplar ring: slowest/most recent requests with
+            # their phase breakdowns (the router fans this out fleet-wide
+            # on /fleet/slow_requests).
+            self._reply(
+                200,
+                {
+                    **self.app.exemplars.stats(),
+                    "slow_requests": self.app.exemplars.snapshot(),
+                },
+            )
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -436,58 +508,101 @@ class _Handler(BaseHTTPRequestHandler):
             self.app.metrics.observe_reset()
         self._reply(200, out)
 
+    def _fail_act(self, code, phases, session_id, t0, outcome, body):
+        """One exit for every non-200 /act path: metrics, exemplar ring
+        (failures are exactly the exemplars a post-mortem wants), and the
+        request id echoed so the client can quote it."""
+        if outcome == "failed":
+            self.app.metrics.observe_request(
+                time.perf_counter() - t0, ok=False
+            )
+        body["request_id"] = phases.request_id
+        self.app.exemplars.offer(
+            (obs_trace.now_us() - phases.t_admit) / 1e3,
+            request_id=phases.request_id,
+            session=session_id,
+            outcome=outcome,
+            error=body.get("error"),
+            phases=phases.phases_ms(),
+        )
+        self._reply(code, body)
+
     def _act(self, payload):
-        if self.app.draining:
-            self._reply(503, {"error": "draining"})
-            return
+        phases = reqtrace.RequestPhases(
+            reqtrace.request_id_from(self.headers, payload)
+        )
         t0 = time.perf_counter()
-        try:
-            session_id = self._session_id(payload)
-            obs = parse_observation(
-                payload, self.app.image_shape, self.app.embed_dim
-            )
-            result = self.app.act(session_id, obs)
-        except RequestError as exc:
-            self.app.metrics.observe_request(
-                time.perf_counter() - t0, ok=False
-            )
-            self._reply(400, {"error": str(exc)})
+        if self.app.draining:
+            # Same contract as every other /act exit: the id is echoed
+            # and the shed request is an exemplar (a drain-window 503 is
+            # post-mortem material like any other rejection).
+            self._fail_act(
+                503, phases, payload.get("session_id"), t0,
+                "rejected", {"error": "draining"})
             return
-        except BusyError:
-            self._reply(503, {"error": "busy", "retry": True})
-            return
-        except DrainingError:
-            self._reply(503, {"error": "draining"})
-            return
-        except concurrent.futures.TimeoutError:
-            self.app.metrics.observe_request(
-                time.perf_counter() - t0, ok=False
-            )
-            self._reply(504, {"error": "request timed out in the server"})
-            return
-        except (SessionError, ValueError, KeyError) as exc:
-            # KeyError: a TableInstructionEmbedder miss. The engine turned
-            # per-item failures into markers; app.act re-raised this one —
-            # batchmates were unaffected.
-            self.app.metrics.observe_request(
-                time.perf_counter() - t0, ok=False
-            )
-            self._reply(400, {"error": str(exc)})
-            return
-        except Exception as exc:  # noqa: BLE001 - last-resort HTTP 500
-            self.app.metrics.observe_request(
-                time.perf_counter() - t0, ok=False
-            )
-            self._reply(500, {"error": f"internal error: {exc}"})
-            return
+        session_id = None
+        with obs_trace.span(
+            "replica_act",
+            request_id=phases.request_id,
+            replica=self.app.replica_id,
+        ):
+            try:
+                session_id = self._session_id(payload)
+                obs = parse_observation(
+                    payload, self.app.image_shape, self.app.embed_dim
+                )
+                result = self.app.act(session_id, obs, phases)
+            except RequestError as exc:
+                self._fail_act(400, phases, session_id, t0,
+                               "failed", {"error": str(exc)})
+                return
+            except BusyError:
+                self._fail_act(503, phases, session_id, t0,
+                               "rejected",
+                               {"error": "busy", "retry": True})
+                return
+            except DrainingError:
+                self._fail_act(503, phases, session_id, t0,
+                               "rejected", {"error": "draining"})
+                return
+            except concurrent.futures.TimeoutError:
+                self._fail_act(
+                    504, phases, session_id, t0, "failed",
+                    {"error": "request timed out in the server"})
+                return
+            except (SessionError, ValueError, KeyError) as exc:
+                # KeyError: a TableInstructionEmbedder miss. The engine
+                # turned per-item failures into markers; app.act re-raised
+                # this one — batchmates were unaffected.
+                self._fail_act(400, phases, session_id, t0,
+                               "failed", {"error": str(exc)})
+                return
+            except Exception as exc:  # noqa: BLE001 - last-resort HTTP 500
+                self._fail_act(500, phases, session_id, t0,
+                               "failed",
+                               {"error": f"internal error: {exc}"})
+                return
         self.app.metrics.observe_request(time.perf_counter() - t0)
+        phases.t_done = obs_trace.now_us()
+        phases.emit_trace(session_id)
+        breakdown = phases.phases_ms()
+        self.app.exemplars.offer(
+            breakdown["total_ms"],
+            request_id=phases.request_id,
+            session=session_id,
+            outcome="ok",
+            phases=breakdown,
+        )
         out = {
             "action": [float(x) for x in result["action"]],
             "action_tokens": [int(x) for x in result["action_tokens"]],
             # True when this step started a fresh (zeroed) window — a
             # client that did not /reset just lost its slot to LRU reclaim.
             "session_started": result.get("session_started", False),
+            "request_id": phases.request_id,
         }
+        if payload.get(reqtrace.DEBUG_KEY):
+            out["phases"] = breakdown
         if "terminate_episode" in result:
             out["terminate_episode"] = result["terminate_episode"]
         self._reply(200, out)

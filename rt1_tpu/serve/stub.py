@@ -19,6 +19,15 @@ the stub catch the drift.
 Actions are deterministic in (session, step): ``action[i] = ((step * 7 + i)
 % 13 - 6) / 300`` — enough structure for a test to assert that a re-homed
 session restarted from step 0.
+
+Tracing parity: the stub resolves the same `X-RT1-Request-Id`, stamps the
+same `serve/reqtrace.py` phase ledger, emits the same `replica_act` /
+`batch_wait` / `device_step` spans, keeps the same slow-request exemplar
+ring behind `GET /slow_requests`, and echoes `request_id` (+ `phases`
+under `"debug": true`) — so the tier-1 fleet tests prove end-to-end id
+propagation without booting a model. `GET /trace` returns the process's
+Chrome-trace ring (test-double introspection hook; the real replica dumps
+traces to disk instead).
 """
 
 from __future__ import annotations
@@ -32,6 +41,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Tuple
 
 from rt1_tpu.obs import prometheus as obs_prometheus
+from rt1_tpu.obs import trace as obs_trace
+from rt1_tpu.obs.recorder import ExemplarRing
+from rt1_tpu.serve import reqtrace
 from rt1_tpu.serve.metrics import ServeMetrics
 
 IMAGE_SHAPE = (8, 14, 3)  # tiny but nonzero: loadgen reads this contract
@@ -51,12 +63,14 @@ class StubReplicaApp:
         max_sessions: int = 8,
         act_delay_s: float = 0.0,
         reload_delay_s: float = 0.05,
+        slow_threshold_ms: float = 0.0,
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
         self.act_delay_s = act_delay_s
         self.reload_delay_s = reload_delay_s
         self.metrics = ServeMetrics()
+        self.exemplars = ExemplarRing(threshold_ms=slow_threshold_ms)
         self.ready = True
         self.draining = False
         self.reloading = False
@@ -68,7 +82,45 @@ class StubReplicaApp:
 
     # ------------------------------------------------------------- handlers
 
-    def act(self, payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+    def act(
+        self, payload: Dict[str, Any], headers=None
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Same request-tracing contract as the real `/act`: one resolved
+        request id spanning a `replica_act` span, a phase ledger stamped
+        through the (instantaneous) queue and the simulated device step,
+        the exemplar ring, and the id echoed in every response."""
+        phases = reqtrace.RequestPhases(
+            reqtrace.request_id_from(headers, payload)
+        )
+        with obs_trace.span(
+            "replica_act",
+            request_id=phases.request_id,
+            replica=self.replica_id,
+        ):
+            code, body = self._act_inner(payload, phases)
+        body["request_id"] = phases.request_id
+        phases.t_done = obs_trace.now_us()
+        if code == 200:
+            phases.emit_trace(payload.get("session_id"))
+            outcome = "ok"
+        else:
+            outcome = "rejected" if code == 503 else "failed"
+        breakdown = phases.phases_ms()
+        self.exemplars.offer(
+            breakdown["total_ms"] or 0.0,
+            request_id=phases.request_id,
+            session=payload.get("session_id"),
+            outcome=outcome,
+            error=body.get("error"),
+            phases=breakdown,
+        )
+        if code == 200 and payload.get(reqtrace.DEBUG_KEY):
+            body["phases"] = breakdown
+        return code, body
+
+    def _act_inner(
+        self, payload: Dict[str, Any], phases: reqtrace.RequestPhases
+    ) -> Tuple[int, Dict[str, Any]]:
         session_id = payload.get("session_id")
         if not isinstance(session_id, str) or not session_id:
             return 400, {"error": "'session_id' must be a non-empty string"}
@@ -77,13 +129,21 @@ class StubReplicaApp:
         if self.draining:
             return 503, {"error": "draining"}
         t0 = time.perf_counter()
-        if self.act_delay_s:
-            time.sleep(self.act_delay_s)  # inside the timer: the stub's
-            #   latency histogram must reflect the simulated step cost
-        with self._lock:
-            started = session_id not in self._sessions
-            step = self._sessions.get(session_id, 0)
-            self._sessions[session_id] = step + 1
+        # The stub has no real batcher: admission, queue, and formation
+        # collapse to back-to-back stamps (their deltas read ~0 ms, which
+        # is the truthful value for a model-free replica).
+        phases.t_enqueue = obs_trace.now_us()
+        phases.t_formed = obs_trace.now_us()
+        phases.t_device0 = obs_trace.now_us()
+        with reqtrace.device_step_span(1, [phases.request_id]):
+            if self.act_delay_s:
+                time.sleep(self.act_delay_s)  # inside the timer: the
+                #   latency histogram must reflect the simulated step cost
+            with self._lock:
+                started = session_id not in self._sessions
+                step = self._sessions.get(session_id, 0)
+                self._sessions[session_id] = step + 1
+        phases.t_device1 = obs_trace.now_us()
         self.metrics.observe_request(time.perf_counter() - t0)
         self.metrics.observe_batch(1, queued=0)
         return 200, {
@@ -169,6 +229,7 @@ class StubReplicaApp:
             "ready": int(self.ready),
             "reloading": int(self.reloading),
             "replica_id": self.replica_id,
+            "slow_exemplars": len(self.exemplars),
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -209,6 +270,23 @@ class _StubHandler(BaseHTTPRequestHandler):
                 self.wfile.write(text)
             else:
                 self._reply(200, self.app.metrics_snapshot())
+        elif self.path == "/slow_requests":
+            self._reply(
+                200,
+                {
+                    **self.app.exemplars.stats(),
+                    "slow_requests": self.app.exemplars.snapshot(),
+                },
+            )
+        elif self.path == "/trace":
+            # Test-double introspection: the process's Chrome-trace ring
+            # (empty when no recorder is installed). Lets a fleet test
+            # assert the replica-side spans carry the propagated request
+            # id without reaching into a subprocess's memory.
+            tracer = obs_trace.active()
+            self._reply(
+                200, tracer.to_dict() if tracer else {"traceEvents": []}
+            )
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -219,8 +297,11 @@ class _StubHandler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as exc:
             self._reply(400, {"error": f"invalid JSON body: {exc}"})
             return
+        if self.path == "/act":
+            code, body = self.app.act(payload, headers=self.headers)
+            self._reply(code, body)
+            return
         ops = {
-            "/act": self.app.act,
             "/reset": self.app.reset,
             "/release": self.app.release,
             "/reload": self.app.reload,
@@ -255,13 +336,20 @@ def main(argv=None) -> int:
         "--act_delay_s", type=float, default=0.0,
         help="Simulated device-step latency per /act.")
     parser.add_argument("--reload_delay_s", type=float, default=0.05)
+    parser.add_argument(
+        "--slow_threshold_ms", type=float, default=0.0,
+        help="Exemplar-ring threshold (0 keeps the most recent window).")
     args = parser.parse_args(argv)
 
+    # Bounded in-process trace ring so GET /trace (and the fleet tests'
+    # span-propagation assertions) see real replica-side spans.
+    obs_trace.enable(max_events=4096)
     app = StubReplicaApp(
         replica_id=args.replica_id,
         max_sessions=args.max_sessions,
         act_delay_s=args.act_delay_s,
         reload_delay_s=args.reload_delay_s,
+        slow_threshold_ms=args.slow_threshold_ms,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
     if args.startup_delay_s:

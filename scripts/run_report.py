@@ -1,11 +1,16 @@
 #!/usr/bin/env python
-"""One post-mortem report per run: goodput + flight recorder + TB scalars.
+"""One post-mortem report per run: goodput + flight recorder + TB scalars
++ the serving SLO story.
 
 After a run ends (cleanly, by preemption, or face-down), the evidence is
 scattered: ``goodput_summary.json`` says where the hours went,
 ``flight_record.jsonl`` has the last seconds at per-step resolution, and
 the TensorBoard event files hold the scalar history (loss, `health/*`
-model-health gauges, `timing/*` buckets). This script merges the three
+model-health gauges, `timing/*` buckets). A serving/chaos run adds its
+own artifacts — ``slo_summary.json`` (the SLO ledger's judgement),
+``BENCH_serve_fleet.json`` (the loadgen record, incl. per-replica fleet
+metrics), ``slow_requests.jsonl`` (the slow-request exemplar ring) — and
+those render as a serve post-mortem section. This script merges them
 into one human-readable report::
 
     python scripts/run_report.py --workdir /tmp/run            # stdout
@@ -63,6 +68,39 @@ def load_flight(workdir: str) -> Optional[Dict[str, Any]]:
     if not os.path.exists(path):
         return None
     return recorder.read_dump(path)
+
+
+def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
+    """Serving artifacts, any subset: SLO summary, loadgen BENCH record,
+    slow-request exemplar dump. None when the workdir has none of them
+    (a pure training run keeps its report serve-free)."""
+    from rt1_tpu.obs import recorder
+    from rt1_tpu.obs import slo as slo_mod
+
+    out: Dict[str, Any] = {}
+    path = os.path.join(workdir, slo_mod.SUMMARY_BASENAME)
+    if os.path.exists(path):
+        try:
+            out["slo"] = slo_mod.read_summary(path)
+        except (json.JSONDecodeError, OSError):
+            pass  # half-written summary from a crashed run
+    for name in ("BENCH_serve_fleet.json", "BENCH_serving.json"):
+        path = os.path.join(workdir, name)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    out["bench"] = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                pass
+            else:
+                break
+    path = os.path.join(workdir, "slow_requests.jsonl")
+    if os.path.exists(path):
+        try:
+            out["exemplars"] = recorder.read_exemplars(path)
+        except OSError:
+            pass
+    return out or None
 
 
 def load_tb_scalars(workdir: str) -> Optional[Dict[str, Tuple[int, float]]]:
@@ -258,12 +296,123 @@ def render_scalars(
     return lines
 
 
+def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
+    """The serve post-mortem: SLO verdict, per-class outcome table,
+    fleet/chaos evidence from the BENCH record, slowest exemplars."""
+    lines = ["## Serve post-mortem (SLO ledger)", ""]
+    slo = serve.get("slo") if serve else None
+    bench = serve.get("bench") if serve else None
+    exemplars = serve.get("exemplars") if serve else None
+    if slo is None and bench is None and exemplars is None:
+        lines.append(
+            "No serving artifacts (slo_summary.json / BENCH_serve_*.json / "
+            "slow_requests.jsonl) in the workdir."
+        )
+        return lines
+    if slo is not None:
+        obj = slo.get("objectives", {})
+        lines.append(
+            f"Objectives: availability >= {obj.get('availability', 0):.4g}, "
+            f"p50 <= {obj.get('latency_p50_ms', 0):.4g} ms, "
+            f"p99 <= {obj.get('latency_p99_ms', 0):.4g} ms "
+            f"(rolling window {obj.get('window', '?')} requests)."
+        )
+        lines.append(
+            f"Availability {slo.get('availability', 0) * 100:.3f}% "
+            f"(rolling {slo.get('availability_rolling', 0) * 100:.3f}%) — "
+            f"error budget burned "
+            f"{slo.get('error_budget_burn', 0) * 100:.1f}% "
+            f"(rolling {slo.get('error_budget_burn_rolling', 0) * 100:.1f}%)."
+        )
+        lines.append(
+            f"Answered latency p50 {slo.get('latency_p50_ms', 0):.2f} ms / "
+            f"p99 {slo.get('latency_p99_ms', 0):.2f} ms."
+        )
+        lines.append("")
+        lines.append(
+            f"{'class':<12}{'count':>8}{'p50 ms':>10}{'p99 ms':>10}"
+            f"{'budget burn':>13}"
+        )
+        for klass, row in slo.get("by_class", {}).items():
+            burn = row.get("error_budget_burn")
+            burn_s = f"{burn * 100:>12.1f}%" if burn is not None else (
+                f"{'-':>13}"
+            )
+            lines.append(
+                f"{klass:<12}{row.get('count', 0):>8}"
+                f"{row.get('p50_ms', 0):>10.2f}{row.get('p99_ms', 0):>10.2f}"
+                + burn_s
+            )
+        lines.append("")
+        lines.append(
+            "SLO met." if slo.get("slo_met")
+            else "SLO VIOLATED — "
+            + ", ".join(
+                name
+                for name, ok in (
+                    ("availability", slo.get("availability_within_objective")),
+                    ("latency", slo.get("latency_within_objective")),
+                )
+                if not ok
+            )
+            + " outside objective."
+        )
+    if bench is not None:
+        lines.append("")
+        lines.append(
+            f"Loadgen: {bench.get('value', 0)} {bench.get('unit', '')} — "
+            f"{bench.get('requests_ok', 0)} ok, "
+            f"{bench.get('requests_restarted', 0)} restarted, "
+            f"{bench.get('requests_rejected', 0)} rejected, "
+            f"{bench.get('requests_failed', 0)} FAILED."
+        )
+        if bench.get("fleet_replicas"):
+            lines.append(
+                f"Fleet: {bench['fleet_replicas']} replicas, faults "
+                f"{bench.get('faults') or 'none'!r}, "
+                f"{bench.get('replica_restarts_total', 0)} restart(s), "
+                f"compile counts {bench.get('replica_compile_counts')}, "
+                f"{bench.get('replicas_ready_at_end', '?')} ready at end."
+            )
+    records = (exemplars or {}).get("records", [])
+    if exemplars is not None:
+        header = exemplars.get("header", {})
+        lines.append("")
+        lines.append(
+            f"Slow-request exemplars: {len(records)} retained "
+            f"(threshold {header.get('threshold_ms', 0)} ms, "
+            f"{header.get('offered', '?')} offered, dump reason "
+            f"{header.get('reason', '?')})."
+        )
+        slowest = sorted(
+            records, key=lambda r: r.get("total_ms", 0.0), reverse=True
+        )[:tail]
+        if slowest:
+            lines.append(
+                f"{'request_id':<20}{'total ms':>10}{'queue ms':>10}"
+                f"{'device ms':>10}  outcome"
+            )
+            for rec in slowest:
+                phases = rec.get("phases") or {}
+                q = phases.get("queue_wait_ms")
+                d = phases.get("device_ms")
+                lines.append(
+                    f"{str(rec.get('request_id', '?')):<20}"
+                    f"{rec.get('total_ms', 0.0):>10.2f}"
+                    + (f"{q:>10.2f}" if q is not None else f"{'-':>10}")
+                    + (f"{d:>10.2f}" if d is not None else f"{'-':>10}")
+                    + f"  {rec.get('outcome', '?')}"
+                )
+    return lines
+
+
 def render_report(
     workdir: str,
     goodput: Optional[Dict[str, Any]],
     flight: Optional[Dict[str, Any]],
     tb: Optional[Dict[str, Tuple[int, float]]],
     tail: int = 8,
+    serve: Optional[Dict[str, Any]] = None,
 ) -> str:
     sections = [
         [f"# RT-1 run report — {workdir}", ""],
@@ -276,6 +425,11 @@ def render_report(
         render_scalars(tb),
         [""],
     ]
+    # Serve section only when a serving artifact exists: a training-only
+    # workdir keeps its report unchanged (and its golden tests green).
+    if serve is not None:
+        sections.insert(1, [""])
+        sections.insert(1, render_serve(serve, tail=tail))
     return "\n".join(line for sec in sections for line in sec)
 
 
@@ -294,6 +448,7 @@ def main(argv=None):
         load_flight(args.workdir),
         load_tb_scalars(args.workdir),
         tail=args.tail,
+        serve=load_serve(args.workdir),
     )
     if args.out:
         with open(args.out, "w") as f:

@@ -42,6 +42,18 @@ latency percentiles in the output:
 
 503s with ``retry: true`` are retried with a short backoff and counted
 (`requests_busy_retried`) — backpressure is a measured quantity here.
+
+The outcome stream also feeds the serving SLO ledger
+(`rt1_tpu/obs/slo.py`): the BENCH JSON carries an ``slo`` section
+(availability, p50/p99 vs objective, error-budget burn per outcome class)
+and the same judgement is written as a ``slo_summary.json`` artifact next
+to ``--output`` (or at ``--slo_summary``) for `scripts/run_report.py`.
+
+``--traced`` sends a client request id (`X-RT1-Request-Id`) plus
+``"debug": true`` on every /act and verifies the id round-trips
+(`request_id_mismatches` must stay 0); ``--overhead_ab N`` measures the
+tracing tax — N alternating traced/untraced passes, best-of per side —
+as ``tracing_overhead_pct`` (budget: <2%).
 """
 
 from __future__ import annotations
@@ -49,6 +61,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import signal
 import subprocess
 import sys
@@ -58,6 +71,14 @@ import urllib.error
 import urllib.request
 
 import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/serve_loadgen.py`
+    sys.path.insert(0, _REPO)
+
+from rt1_tpu.obs.quantiles import percentile  # noqa: E402
+from rt1_tpu.obs.slo import SLOLedger, SLOObjectives  # noqa: E402
+from rt1_tpu.serve.reqtrace import REQUEST_ID_HEADER  # noqa: E402
 
 INSTRUCTION_POOL = (
     "push the red moon to the blue cube",
@@ -69,11 +90,13 @@ INSTRUCTION_POOL = (
 OUTCOME_CLASSES = ("ok", "restarted", "rejected", "failed")
 
 
-def _post(url: str, payload: dict, timeout: float) -> tuple[int, dict]:
+def _post(
+    url: str, payload: dict, timeout: float, headers: dict | None = None
+) -> tuple[int, dict]:
     req = urllib.request.Request(
         url,
         data=json.dumps(payload).encode("utf-8"),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
         method="POST",
     )
     try:
@@ -111,12 +134,20 @@ def _session_worker(
     barrier: threading.Barrier,
     out: dict,
     rng: np.random.Generator,
+    traced: bool = False,
 ):
-    # latencies[class] = [seconds]; record a result no matter how this
-    # thread exits, and never skip the barrier: a missing wait would
-    # deadlock every other session.
+    # latencies[class] = [seconds]; `events` is the same stream in
+    # completion order (t_end, class, seconds) so the SLO ledger's
+    # rolling window sees requests the way a router would. Record a
+    # result no matter how this thread exits, and never skip the
+    # barrier: a missing wait would deadlock every other session.
     latencies = {k: [] for k in OUTCOME_CLASSES}
-    record = {"latencies": latencies, "busy": 0}
+    record = {
+        "latencies": latencies,
+        "events": [],
+        "busy": 0,
+        "rid_mismatches": 0,
+    }
     out[session_id] = record  # in place from the start: a dying thread
     #                           still leaves a valid (partial) record
     status, _ = _post(url + "/reset", {"session_id": session_id}, timeout)
@@ -126,6 +157,7 @@ def _session_worker(
         # (not a per-step fabrication, which would poison the failed-class
         # percentiles and the duration-mode counts).
         latencies["failed"].append(0.0)
+        record["events"].append((time.perf_counter(), "failed", 0.0))
         return
     deadline = time.perf_counter() + duration_s if duration_s > 0 else None
     step = 0
@@ -142,10 +174,18 @@ def _session_worker(
             "image_b64": base64.b64encode(frame.tobytes()).decode("ascii"),
             "instruction": instruction,
         }
+        headers = None
+        if traced:
+            # Client-minted id + debug phases: proves the propagation
+            # contract under load (the server must echo the id, and the
+            # phase breakdown must carry the same one).
+            rid = f"{session_id}-{step:06d}"
+            headers = {REQUEST_ID_HEADER: rid}
+            payload["debug"] = True
         retries = 0
         t0 = time.perf_counter()
         while True:
-            status, body = _post(url + "/act", payload, timeout)
+            status, body = _post(url + "/act", payload, timeout, headers)
             if (
                 status == 503
                 and body.get("retry")
@@ -159,11 +199,17 @@ def _session_worker(
         elapsed = time.perf_counter() - t0
         if status == 200 and "action" in body:
             klass = "restarted" if body.get("restarted") else "ok"
+            if traced and (
+                body.get("request_id") != rid
+                or (body.get("phases") or {}).get("request_id") != rid
+            ):
+                record["rid_mismatches"] += 1
         elif status == 503:
             klass = "rejected"  # shed after the retry budget
         else:
             klass = "failed"  # transport death or unexpected 4xx/5xx
         latencies[klass].append(elapsed)
+        record["events"].append((time.perf_counter(), klass, elapsed))
         if think_time_s > 0:
             # Jittered arrivals: uniform on [0, 2*mean] keeps the mean
             # think time while decorrelating sessions.
@@ -177,13 +223,6 @@ def _barrier_wait(barrier: threading.Barrier, timeout: float) -> None:
         pass  # a sibling died/timed out; run unsynchronized rather than hang
 
 
-def _pct(sorted_latencies: list, q: float) -> float:
-    if not sorted_latencies:
-        return 0.0
-    index = min(int(q * len(sorted_latencies)), len(sorted_latencies) - 1)
-    return sorted_latencies[index]
-
-
 def run_loadgen(
     url: str,
     sessions: int = 8,
@@ -194,6 +233,8 @@ def run_loadgen(
     timeout: float = 30.0,
     max_retries: int = 400,
     seed: int = 0,
+    traced: bool = False,
+    slo_objectives: SLOObjectives | None = None,
 ) -> dict:
     """Run the synthetic load and return the BENCH-style result dict.
 
@@ -201,6 +242,8 @@ def run_loadgen(
     (chaos runs want a fixed observation window, not a fixed request
     count). Latency percentiles are reported overall AND per outcome
     class, so "how slow was a restarted request" is a first-class number.
+    The whole outcome stream is replayed (in completion order) into an
+    `SLOLedger`, whose judgement rides the result as ``"slo"``.
     """
     url = url.rstrip("/")
     health = _get(url + "/healthz", timeout)
@@ -227,6 +270,7 @@ def run_loadgen(
                 barrier,
                 out,
                 rng,
+                traced,
             ),
             name=f"loadgen-{i}",
         )
@@ -246,7 +290,19 @@ def run_loadgen(
     }
     answered = sorted(by_class["ok"] + by_class["restarted"])
     busy = sum(result["busy"] for result in out.values())
+    rid_mismatches = sum(
+        result.get("rid_mismatches", 0) for result in out.values()
+    )
     server_metrics = _get(url + "/metrics", timeout)
+
+    # Client-side SLO ledger: the merged event stream in completion order,
+    # so the rolling-window gauges mean what they would on the router.
+    ledger = SLOLedger(slo_objectives or SLOObjectives())
+    events = sorted(
+        event for result in out.values() for event in result["events"]
+    )
+    for _, klass, seconds in events:
+        ledger.observe(klass, seconds)
 
     result = {
         "metric": "serve_requests_per_sec",
@@ -262,16 +318,21 @@ def run_loadgen(
         "requests_failed": len(by_class["failed"]),
         "requests_busy_retried": busy,
         "wall_s": round(wall, 4),
-        "latency_p50_ms": round(_pct(answered, 0.50) * 1e3, 3),
-        "latency_p99_ms": round(_pct(answered, 0.99) * 1e3, 3),
+        # Shared estimator (rt1_tpu/obs/quantiles.py): the same
+        # nearest-rank percentile the SLO ledger and serve metrics use.
+        "latency_p50_ms": round(percentile(answered, 0.50) * 1e3, 3),
+        "latency_p99_ms": round(percentile(answered, 0.99) * 1e3, 3),
         "latency_by_class": {
             klass: {
                 "count": len(lats),
-                "p50_ms": round(_pct(lats, 0.50) * 1e3, 3),
-                "p99_ms": round(_pct(lats, 0.99) * 1e3, 3),
+                "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
+                "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
             }
             for klass, lats in by_class.items()
         },
+        "traced": traced,
+        "request_id_mismatches": rid_mismatches if traced else None,
+        "slo": ledger.summary(),
         "mean_batch_occupancy": round(
             server_metrics.get("mean_batch_occupancy", 0.0), 3
         ),
@@ -280,6 +341,81 @@ def run_loadgen(
         "image_shape": list(image_shape),
     }
     return result
+
+
+# --------------------------------------------------------------- overhead
+
+
+def run_overhead_ab(args) -> dict:
+    """Traced-vs-untraced request-rate A/B against one server.
+
+    "Traced" = client request id header + ``debug: true`` phases on every
+    request — the full per-request tracing surface. Sides alternate
+    (A/B then B/A per round) and each side reports its best pass, because
+    on a co-tenant-loaded host a whole pass can be poisoned by CPU theft;
+    the max over alternating passes is the honest throughput floor-free
+    comparison (same methodology as bench.py --health A/B).
+    """
+    sides: dict = {"untraced": [], "traced": []}
+    order = ("untraced", "traced")
+    image_shape = None
+    if args.height and args.width:
+        image_shape = (args.height, args.width, 3)
+    for round_i in range(args.overhead_ab):
+        for side in order if round_i % 2 == 0 else order[::-1]:
+            r = run_loadgen(
+                args.url,
+                sessions=args.sessions,
+                steps=args.steps,
+                duration_s=args.duration,
+                think_time_s=args.think_time,
+                image_shape=image_shape,
+                timeout=args.timeout,
+                max_retries=args.max_retries,
+                seed=args.seed + round_i,
+                traced=side == "traced",
+            )
+            sides[side].append(
+                {
+                    "req_per_sec": r["value"],
+                    "p50_ms": r["latency_p50_ms"],
+                    "failed": r["requests_failed"],
+                    "rid_mismatches": r["request_id_mismatches"],
+                }
+            )
+    best = {
+        side: max(p["req_per_sec"] for p in passes)
+        for side, passes in sides.items()
+    }
+    overhead_pct = (
+        (best["untraced"] - best["traced"]) / best["untraced"] * 100.0
+        if best["untraced"] > 0
+        else 0.0
+    )
+    return {
+        "metric": "serve_tracing_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "budget_pct": 2.0,
+        # A side that answered nothing measures nothing: no verdict.
+        "within_budget": overhead_pct < 2.0 and best["untraced"] > 0,
+        "rounds": args.overhead_ab,
+        "sessions": args.sessions,
+        "steps_per_session": args.steps,
+        "best_req_per_sec": {k: round(v, 3) for k, v in best.items()},
+        "passes": sides,
+        "request_id_mismatches": sum(
+            p["rid_mismatches"] or 0 for p in sides["traced"]
+        ),
+        "requests_failed": sum(
+            p["failed"] for passes in sides.values() for p in passes
+        ),
+        "timing_methodology": (
+            "alternating traced/untraced passes (ABBA), best-of per side; "
+            "single pass pairs are unreliable on a host with bursty "
+            "co-tenant CPU theft"
+        ),
+    }
 
 
 # ------------------------------------------------------------------ fleet
@@ -297,6 +433,9 @@ def run_fleet_chaos(args) -> dict:
         "--max_sessions", str(args.max_sessions),
         "--chaos_interval_s", str(args.chaos_interval_s),
         "--replica_timeout_s", str(args.replica_timeout_s),
+        "--slo_availability", str(args.slo_availability),
+        "--slo_p50_ms", str(args.slo_p50_ms),
+        "--slo_p99_ms", str(args.slo_p99_ms),
     ]
     if args.faults:
         cmd += ["--faults", args.faults]
@@ -346,6 +485,8 @@ def run_fleet_chaos(args) -> dict:
             timeout=args.timeout,
             max_retries=args.max_retries,
             seed=args.seed,
+            traced=args.traced,
+            slo_objectives=_objectives(args),
         )
         # Let the fleet heal before sampling the final evidence: a
         # replica killed late in the window may still be respawning (jax
@@ -398,6 +539,12 @@ def run_fleet_chaos(args) -> dict:
             # live replica (including post-kill respawns) compiled once.
             "replica_compile_counts": compile_counts,
             "chaos": final_line.get("chaos"),
+            # Server-side judgement + crash-surviving exemplars from the
+            # fleet's final status line. The client-side ledger (result
+            # "slo") sees retries/transport failures the router cannot;
+            # both views belong in the record.
+            "server_slo": final_line.get("slo"),
+            "slow_requests": final_line.get("slow_requests"),
             "stub": bool(args.stub),
         }
     )
@@ -441,6 +588,27 @@ def main() -> int:
     parser.add_argument(
         "--output", default="",
         help="Also write the JSON to this path (stdout either way).")
+    parser.add_argument(
+        "--traced", action="store_true",
+        help="Send a client request id (X-RT1-Request-Id) + debug:true "
+             "phases on every /act and verify the id round-trips.")
+    parser.add_argument(
+        "--overhead_ab", type=int, default=0,
+        help="Measure tracing overhead: N alternating traced/untraced "
+             "rounds against --url, best-of per side (budget <2%%).")
+    parser.add_argument(
+        "--slo_availability", type=float, default=0.99,
+        help="SLO objective: fraction of requests that must be ok.")
+    parser.add_argument(
+        "--slo_p50_ms", type=float, default=250.0,
+        help="SLO objective: answered-request p50 (ms).")
+    parser.add_argument(
+        "--slo_p99_ms", type=float, default=2500.0,
+        help="SLO objective: answered-request p99 (ms).")
+    parser.add_argument(
+        "--slo_summary", default="",
+        help="Write the SLO ledger judgement here (default: "
+             "slo_summary.json next to --output when --output is set).")
     # Fleet mode: spawn and chaos-drive python -m rt1_tpu.serve.fleet.
     parser.add_argument(
         "--fleet", type=int, default=0,
@@ -471,6 +639,8 @@ def main() -> int:
         if not args.stub and not args.config:
             parser.error("--fleet needs --config (or --stub)")
         result = run_fleet_chaos(args)
+    elif args.overhead_ab > 0:
+        result = run_overhead_ab(args)
     else:
         image_shape = None
         if args.height and args.width:
@@ -485,13 +655,34 @@ def main() -> int:
             timeout=args.timeout,
             max_retries=args.max_retries,
             seed=args.seed,
+            traced=args.traced,
+            slo_objectives=_objectives(args),
         )
     line = json.dumps(result)
     print(line)
     if args.output:
         with open(args.output, "w") as f:
             f.write(line + "\n")
+    slo_path = args.slo_summary
+    if not slo_path and args.output and "slo" in result:
+        slo_path = os.path.join(
+            os.path.dirname(os.path.abspath(args.output)), "slo_summary.json"
+        )
+    if slo_path and "slo" in result:
+        tmp = slo_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result["slo"], f, indent=2)
+        os.replace(tmp, slo_path)  # readers never see a half-written file
+        print(f"slo summary written to {slo_path}", file=sys.stderr)
     return 0 if result["requests_failed"] == 0 else 1
+
+
+def _objectives(args) -> SLOObjectives:
+    return SLOObjectives(
+        availability=args.slo_availability,
+        latency_p50_ms=args.slo_p50_ms,
+        latency_p99_ms=args.slo_p99_ms,
+    )
 
 
 if __name__ == "__main__":

@@ -72,9 +72,48 @@ import rt1_tpu.serve.fleet  # noqa: F401 - import-time deps only
 router_text = Router().metrics_prometheus()
 assert "rt1_serve_replicas_total" in router_text
 assert "# TYPE rt1_serve_reloads_total counter" in router_text
+# The router's SLO gauges render on the same scrape (PR 8): the ledger
+# and the shared quantile math are stdlib-only by contract.
+assert "rt1_serve_slo_availability 1" in router_text
+assert "rt1_serve_slo_error_budget_burn 0" in router_text
 stub = StubReplicaApp(replica_id=7)
 assert stub.healthz()["replica_id"] == 7
 assert stub.readyz()[0] == 200
+
+# PR 8 serving-observability pieces: the SLO ledger, the shared
+# percentile helpers, the request tracer, and the exemplar ring all run
+# in the router / replica processes — stdlib + obs only.
+from rt1_tpu.obs.quantiles import bucket_quantile, percentile
+from rt1_tpu.serve import reqtrace
+
+assert percentile([1.0, 2.0, 3.0], 0.5) == 2.0
+assert bucket_quantile((0.1, 1.0), (1, 1), 2, 0.5, 0.99) == 1.0
+
+ledger = obs.SLOLedger(obs.SLOObjectives(availability=0.95))
+ledger.observe("ok", 0.01)
+ledger.observe("restarted", 0.05)
+assert ledger.gauges()["slo_availability"] == 0.5
+assert ledger.summary()["by_class"]["restarted"]["count"] == 1
+
+ring = obs.ExemplarRing(capacity=2, threshold_ms=1.0)
+assert ring.offer(5.0, request_id="r1", outcome="ok")
+assert not ring.offer(0.5, request_id="r2")
+assert ring.stats()["retained"] == 1
+
+phases = reqtrace.RequestPhases(reqtrace.request_id_from(
+    {reqtrace.REQUEST_ID_HEADER: "probe-id"}))
+assert phases.request_id == "probe-id"
+assert phases.phases_ms()["queue_wait_ms"] is None
+
+# The fleet aggregation renderer (the router /metrics text path).
+from rt1_tpu.obs.prometheus import fleet_metric_names, render_fleet_snapshot
+
+fleet_text = render_fleet_snapshot(
+    {"requests_total": 1}, {0: {"compile_count": 1}, 1: None})
+assert 'rt1_serve_replica_up{replica_id="0"} 1' in fleet_text
+assert 'rt1_serve_replica_up{replica_id="1"} 0' in fleet_text
+assert 'rt1_serve_replica_compile_count{replica_id="0"} 1' in fleet_text
+assert "rt1_serve_replica_up" in fleet_metric_names()
 
 # Parallelism plan: serve processes resolve the declarative sharding plan
 # (engine param placement) without the training stack — the whole module,

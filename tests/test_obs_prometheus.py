@@ -178,6 +178,113 @@ def test_metrics_server_scrape_on_ephemeral_port():
         server.close()
 
 
+def test_slo_gauges_roundtrip_serve_renderer():
+    """ISSUE naming contract: every gauge the SLO ledger emits renders as
+    a valid `rt1_serve_slo_*` family through the serve renderer (the
+    exact path the router's /metrics takes), with the value surviving."""
+    from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+
+    ledger = SLOLedger(SLOObjectives(availability=0.99))
+    for _ in range(98):
+        ledger.observe("ok", 0.010)
+    ledger.observe("restarted", 0.030)
+    ledger.observe("rejected", 0.001)
+    gauges = ledger.gauges()
+    text = ServeMetrics().prometheus_text(**gauges)
+    types, samples = parse_exposition(text)
+    by_name = {n: float(v) for n, labels, v in samples if not labels}
+    for key, value in gauges.items():
+        name = "rt1_serve_" + key
+        assert name in by_name, f"{key} did not render"
+        assert types[name] == "gauge"
+        assert by_name[name] == pytest.approx(value)
+    assert by_name["rt1_serve_slo_requests_total"] == 100.0
+    assert by_name["rt1_serve_slo_error_budget_burn"] == pytest.approx(2.0)
+
+
+def test_fleet_snapshot_rendering_labeled_families():
+    """The aggregated fleet exposition: router families at their usual
+    names, per-replica curated fields as `replica_id`-labeled samples,
+    and a probe-failed replica visible ONLY as replica_up 0."""
+    metrics = ServeMetrics()
+    metrics.observe_request(0.01)
+    router_snap = metrics.snapshot(replicas_total=3, replicas_ready=2)
+    replica_snap = {
+        "requests_total": 7,
+        "compile_count": 1,
+        "active_sessions": 2,
+        "queue_depth": 1,
+        "reloads_total": 0,
+        "latency_p99_ms": 12.5,
+        "uptime_s": 33.0,
+        "ready": 1,
+        "ignored_text": "not-a-number",  # non-numeric: skipped, no crash
+    }
+    text = prom.render_fleet_snapshot(
+        router_snap, {0: replica_snap, 1: dict(replica_snap), 2: None}
+    )
+    types, samples = parse_exposition(text)
+    # Router-own families keep single-replica names: dashboards survive.
+    assert types["rt1_serve_requests_total"] == "counter"
+    # Liveness: probed replicas 1, failed probe 0 — absence is a fact.
+    ups = {
+        labels["replica_id"]: float(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_up"
+    }
+    assert ups == {"0": 1.0, "1": 1.0, "2": 0.0}
+    # Curated fields become labeled families; the dead replica has none.
+    reqs = {
+        labels["replica_id"]: float(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_requests_total"
+    }
+    assert reqs == {"0": 7.0, "1": 7.0}
+    assert types["rt1_serve_replica_requests_total"] == "counter"
+    assert types["rt1_serve_replica_compile_count"] == "gauge"
+    # uptime keeps the _seconds suffix convention.
+    uptime = [
+        (labels["replica_id"], float(v))
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_uptime_seconds"
+    ]
+    assert ("0", 33.0) in uptime
+    assert not any(n.endswith("ignored_text") for n, _, _ in samples)
+
+
+def test_fleet_metric_names_all_renderable():
+    """Every name `fleet_metric_names()` promises must be a sanitized,
+    renderable family name (the scrape-config contract docs point at)."""
+    names = prom.fleet_metric_names()
+    assert "rt1_serve_replica_up" in names
+    assert "rt1_serve_replica_compile_count" in names
+    assert "rt1_serve_replica_queue_depth" in names
+    assert "rt1_serve_replica_uptime_seconds" in names
+    assert len(names) == len(set(names))
+    for name in names:
+        assert prom.sanitize_name(name) == name, f"{name} not exposition-safe"
+    # And each one actually renders when a replica carries the field.
+    full = {
+        key: 1.0 for key in prom._FLEET_REPLICA_FIELDS
+    }
+    text = prom.render_fleet_snapshot({}, {0: full})
+    types, _ = parse_exposition(text)
+    for name in names:
+        assert name in types, f"{name} missing from a full snapshot render"
+
+
+def test_family_label_escaping():
+    exp = prom.TextExposition()
+    exp.family(
+        "rt1_test_family",
+        "gauge",
+        [({"replica_id": 'a"b\\c\nd'}, 1.0)],
+    )
+    text = exp.render()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    assert "\n d" not in text  # the raw newline must not split the sample
+
+
 def test_health_and_goodput_gauges_exposition():
     """PR 5 naming contract: the health pack and goodput ledger scalars the
     train loop merges into its stream render as valid rt1_train_health_* /

@@ -1,0 +1,328 @@
+"""obs/slo.py + obs/quantiles.py + the serve-side request tracing pieces
+(`serve/reqtrace.py`, `obs/recorder.py` ExemplarRing): the SLO ledger's
+error-budget arithmetic, the one shared percentile implementation, the
+bounded slow-request ring, and the request-id/phase-ledger contract.
+"""
+
+import json
+import threading
+
+import pytest
+
+from rt1_tpu.obs.quantiles import bucket_quantile, percentile, percentiles_ms
+from rt1_tpu.obs.recorder import ExemplarRing, read_exemplars
+from rt1_tpu.obs.slo import OUTCOMES, SLOLedger, SLOObjectives
+from rt1_tpu.serve import reqtrace
+from rt1_tpu.serve.metrics import LatencyHistogram
+
+
+# ------------------------------------------------------------- quantiles
+
+
+def test_percentile_nearest_rank_and_empty():
+    assert percentile([], 0.99) == 0.0
+    assert percentile([5.0], 0.50) == 5.0
+    values = sorted(float(i) for i in range(100))
+    assert percentile(values, 0.50) == 50.0
+    assert percentile(values, 0.99) == 99.0
+    assert percentile(values, 1.0) == 99.0  # clamped to the last rank
+    assert percentiles_ms([0.010, 0.020, 0.030, 0.040]) == (30.0, 40.0)
+
+
+def test_bucket_quantile_matches_latency_histogram():
+    """The hoisted estimator IS the LatencyHistogram semantics: upper
+    bound of the containing bucket, observed max for the overflow."""
+    hist = LatencyHistogram(buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.005, 0.05, 7.0):
+        hist.observe(v)
+    assert hist.quantile(0.5) == 0.01
+    assert hist.quantile(0.99) == 7.0  # overflow bucket -> observed max
+    assert bucket_quantile((0.001, 0.01, 0.1), (1, 2, 1), 5, 7.0, 0.5) == 0.01
+    assert bucket_quantile((0.001, 0.01, 0.1), (1, 2, 1), 5, 7.0, 0.99) == 7.0
+    assert bucket_quantile((0.001,), (0,), 0, 0.0, 0.5) == 0.0
+
+
+# ------------------------------------------------------------ SLO ledger
+
+
+def test_objectives_validation():
+    with pytest.raises(ValueError, match="availability"):
+        SLOObjectives(availability=0.0)
+    with pytest.raises(ValueError, match="availability"):
+        SLOObjectives(availability=1.1)
+    with pytest.raises(ValueError, match="window"):
+        SLOObjectives(window=0)
+    assert SLOObjectives(availability=0.99).error_budget == pytest.approx(0.01)
+    # availability=1.0 is a legal (if harsh) objective: zero error budget.
+    assert SLOObjectives(availability=1.0).error_budget == 0.0
+
+
+def test_zero_error_budget_judged_by_availability_not_burn():
+    """availability=1.0 leaves no budget to divide by: burn stays 0.0
+    (documented convention, not a division crash) and the availability
+    verdict carries the judgement."""
+    ledger = SLOLedger(SLOObjectives(availability=1.0))
+    ledger.observe("ok", 0.01)
+    ledger.observe("failed", 0.0)
+    gauges = ledger.gauges()
+    assert gauges["slo_availability_ok"] == 0.0
+    assert gauges["slo_error_budget_burn"] == 0.0
+
+
+def test_ledger_rejects_unknown_outcome():
+    with pytest.raises(ValueError, match="unknown outcome"):
+        SLOLedger().observe("timeout", 0.1)
+
+
+def test_ledger_availability_and_burn_arithmetic():
+    """99% objective, 100 requests, 2 bad -> availability 98%, burn 2x."""
+    ledger = SLOLedger(SLOObjectives(availability=0.99))
+    for _ in range(98):
+        ledger.observe("ok", 0.010)
+    ledger.observe("restarted", 0.050)
+    ledger.observe("failed", 0.0)
+    gauges = ledger.gauges()
+    assert gauges["slo_requests_total"] == 100.0
+    assert gauges["slo_availability"] == pytest.approx(0.98)
+    assert gauges["slo_error_budget_burn"] == pytest.approx(2.0)
+    assert gauges["slo_availability_ok"] == 0.0  # 98% < 99% objective
+    # Latency is judged on ANSWERED requests only (ok + restarted): the
+    # failed request's 0-latency must not deflate the percentiles.
+    assert gauges["slo_latency_p50_ms"] == pytest.approx(10.0)
+    assert gauges["slo_latency_p99_ms"] == pytest.approx(50.0)
+
+
+def test_ledger_rolling_window_sees_current_incident():
+    """A long healthy history must not hide a current outage: the rolling
+    availability is computed over the last `window` requests only."""
+    ledger = SLOLedger(SLOObjectives(availability=0.99, window=10))
+    for _ in range(1000):
+        ledger.observe("ok", 0.01)
+    for _ in range(10):
+        ledger.observe("failed", 0.0)
+    gauges = ledger.gauges()
+    assert gauges["slo_availability"] == pytest.approx(1000 / 1010)
+    assert gauges["slo_availability_rolling"] == 0.0
+    assert gauges["slo_error_budget_burn_rolling"] == pytest.approx(100.0)
+
+
+def test_ledger_summary_per_class_burn_sums_to_total():
+    """The by-class error_budget_burn entries answer "who spent the
+    budget" — they must sum to the run's total burn."""
+    ledger = SLOLedger(SLOObjectives(availability=0.95))
+    for _ in range(90):
+        ledger.observe("ok", 0.010)
+    for _ in range(6):
+        ledger.observe("restarted", 0.030)
+    for _ in range(3):
+        ledger.observe("rejected", 0.001)
+    ledger.observe("failed", 0.0)
+    summary = ledger.summary()
+    assert summary["requests_total"] == 100
+    assert set(summary["by_class"]) == set(OUTCOMES)
+    assert "error_budget_burn" not in summary["by_class"]["ok"]
+    class_burns = [
+        summary["by_class"][k]["error_budget_burn"]
+        for k in ("restarted", "rejected", "failed")
+    ]
+    assert sum(class_burns) == pytest.approx(summary["error_budget_burn"])
+    assert summary["availability"] == pytest.approx(0.90)
+    assert summary["availability_within_objective"] is False
+    assert summary["slo_met"] is False
+
+
+def test_ledger_slo_met_when_healthy():
+    ledger = SLOLedger(
+        SLOObjectives(availability=0.99, latency_p50_ms=100, latency_p99_ms=200)
+    )
+    for _ in range(50):
+        ledger.observe("ok", 0.020)
+    summary = ledger.summary()
+    assert summary["availability"] == 1.0
+    assert summary["error_budget_burn"] == 0.0
+    assert summary["slo_met"] is True
+
+
+def test_ledger_latency_objective_violation():
+    ledger = SLOLedger(
+        SLOObjectives(availability=0.5, latency_p50_ms=5.0, latency_p99_ms=10.0)
+    )
+    for _ in range(20):
+        ledger.observe("ok", 0.050)  # 50 ms >> 10 ms p99 objective
+    summary = ledger.summary()
+    assert summary["availability_within_objective"] is True
+    assert summary["latency_within_objective"] is False
+    assert summary["slo_met"] is False
+
+
+def test_ledger_write_and_read_summary(tmp_path):
+    ledger = SLOLedger()
+    ledger.observe("ok", 0.01)
+    path = str(tmp_path / "sub" / "slo_summary.json")
+    assert ledger.write_summary(path) == path
+    from rt1_tpu.obs.slo import read_summary
+
+    loaded = read_summary(path)
+    assert loaded == ledger.summary()
+    assert loaded["objectives"]["availability"] == 0.99
+
+
+def test_ledger_thread_safety_counts():
+    ledger = SLOLedger(SLOObjectives(window=64))
+
+    def hammer(outcome):
+        for _ in range(500):
+            ledger.observe(outcome, 0.001)
+
+    threads = [
+        threading.Thread(target=hammer, args=(o,))
+        for o in ("ok", "ok", "restarted", "failed")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    gauges = ledger.gauges()
+    assert gauges["slo_requests_total"] == 2000.0
+    assert gauges["slo_requests_ok"] == 1000.0
+
+
+# ---------------------------------------------------------- exemplar ring
+
+
+def test_exemplar_ring_threshold_and_bound():
+    ring = ExemplarRing(capacity=4, threshold_ms=10.0)
+    assert not ring.offer(5.0, request_id="fast")
+    for i in range(6):
+        assert ring.offer(20.0 + i, request_id=f"slow-{i}")
+    stats = ring.stats()
+    assert stats["offered"] == 7 and stats["kept"] == 6
+    assert stats["retained"] == 4 and len(ring) == 4
+    # Ring semantics: the most recent 4 survive.
+    assert [r["request_id"] for r in ring.snapshot()] == [
+        "slow-2", "slow-3", "slow-4", "slow-5"
+    ]
+
+
+def test_exemplar_ring_dump_and_read(tmp_path):
+    ring = ExemplarRing(capacity=8, threshold_ms=0.0)
+    ring.offer(12.5, request_id="a", phases={"device_ms": 9.0}, outcome="ok")
+    ring.offer(99.0, request_id="b", outcome="failed", error="boom")
+    path = str(tmp_path / "slow_requests.jsonl")
+    ring.dump(path, reason="drain")
+    loaded = read_exemplars(path)
+    assert loaded["header"]["reason"] == "drain"
+    assert loaded["header"]["offered"] == 2
+    assert [r["request_id"] for r in loaded["records"]] == ["a", "b"]
+    assert loaded["records"][0]["phases"]["device_ms"] == 9.0
+
+    # Truncation tolerance: chop the last line mid-record (hard kill).
+    with open(path) as f:
+        content = f.read()
+    with open(path, "w") as f:
+        f.write(content[: content.rindex('"request_id": "b"')])
+    loaded = read_exemplars(path)
+    assert [r["request_id"] for r in loaded["records"]] == ["a"]
+
+
+def test_exemplar_ring_rejects_bad_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        ExemplarRing(capacity=0)
+
+
+def test_exemplar_ring_keeps_fast_failures():
+    # A 1 ms 503 storm is exactly the exemplar a post-mortem wants: the
+    # slow threshold must not filter degraded outcomes.
+    ring = ExemplarRing(capacity=4, threshold_ms=100.0)
+    assert ring.offer(1.0, request_id="f", outcome="failed")
+    assert ring.offer(1.0, request_id="r", outcome="rejected")
+    assert not ring.offer(1.0, request_id="ok-fast", outcome="ok")
+    assert not ring.offer(1.0, request_id="no-outcome")
+    assert [r["request_id"] for r in ring.snapshot()] == ["f", "r"]
+
+
+# -------------------------------------------------------------- reqtrace
+
+
+def test_request_id_resolution_precedence():
+    # Client header wins over payload; both win over minting.
+    headers = {reqtrace.REQUEST_ID_HEADER: "hdr-id"}
+    assert reqtrace.request_id_from(headers, {"request_id": "body-id"}) == (
+        "hdr-id"
+    )
+    assert reqtrace.request_id_from({}, {"request_id": "body-id"}) == "body-id"
+    minted = reqtrace.request_id_from(None, None)
+    assert len(minted) == 16 and minted != reqtrace.new_request_id()
+    # Client-controlled input is bounded and type-checked.
+    assert len(reqtrace.request_id_from({}, {"request_id": "x" * 500})) == 64
+    assert reqtrace.request_id_from({}, {"request_id": 42}) != 42
+
+
+def test_request_id_sanitized_for_header_forwarding():
+    # The router re-emits the id as an HTTP header on the replica hop:
+    # CR/LF or non-latin-1 would make urllib reject the forwarded request,
+    # which the router cannot tell apart from a replica transport death
+    # (and would falsely orphan the session). Strip, don't fail.
+    assert reqtrace.request_id_from({}, {"request_id": "a\rb\nc"}) == "abc"
+    assert reqtrace.request_id_from({}, {"request_id": "sp aceé"}) == (
+        "space"
+    )
+    # An id with nothing salvageable is replaced by a minted one.
+    assert len(reqtrace.request_id_from({}, {"request_id": "\r\n"})) == 16
+
+
+def test_request_phases_breakdown_and_none_for_unreached():
+    phases = reqtrace.RequestPhases("req-1")
+    phases.t_enqueue = phases.t_admit + 1_000.0   # +1 ms
+    phases.t_formed = phases.t_admit + 3_000.0    # +2 ms queue wait
+    phases.t_device0 = phases.t_admit + 3_500.0
+    phases.t_device1 = phases.t_admit + 9_500.0   # 6 ms device
+    phases.t_done = phases.t_admit + 10_000.0
+    out = phases.phases_ms()
+    assert out["request_id"] == "req-1"
+    assert out["admission_ms"] == pytest.approx(1.0)
+    assert out["queue_wait_ms"] == pytest.approx(2.0)
+    assert out["batch_form_ms"] == pytest.approx(0.5)
+    assert out["device_ms"] == pytest.approx(6.0)
+    assert out["serialize_ms"] == pytest.approx(0.5)
+    assert out["total_ms"] == pytest.approx(10.0)
+
+    # A request rejected before the queue: unreached phases are None,
+    # not fabricated zeros; total still measures admit -> now.
+    rejected = reqtrace.RequestPhases("req-2")
+    out = rejected.phases_ms()
+    assert out["queue_wait_ms"] is None
+    assert out["device_ms"] is None
+    assert out["total_ms"] >= 0.0
+
+
+def test_request_phases_emit_trace_links_request_id():
+    from rt1_tpu.obs import trace as obs_trace
+
+    tracer = obs_trace.enable(max_events=64)
+    try:
+        phases = reqtrace.RequestPhases("linked-1")
+        phases.t_enqueue = obs_trace.now_us()
+        phases.t_formed = phases.t_enqueue + 500.0
+        phases.emit_trace(session_id="s0")
+        with reqtrace.device_step_span(2, ["linked-1", "linked-2"]):
+            pass
+        events = tracer.to_dict()["traceEvents"]
+        waits = [e for e in events if e.get("name") == "batch_wait"]
+        assert len(waits) == 1
+        assert waits[0]["args"]["request_id"] == "linked-1"
+        assert waits[0]["args"]["session"] == "s0"
+        steps = [e for e in events if e.get("name") == "device_step"]
+        assert steps and steps[0]["args"]["request_ids"] == [
+            "linked-1", "linked-2"
+        ]
+    finally:
+        obs_trace.disable()
+
+
+def test_slo_summary_is_json_serializable():
+    ledger = SLOLedger()
+    for outcome in OUTCOMES:
+        ledger.observe(outcome, 0.01)
+    json.dumps(ledger.summary())
+    json.dumps(ledger.gauges())

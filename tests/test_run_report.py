@@ -165,3 +165,129 @@ def test_bar_rendering_bounds():
     assert run_report._bar(100.0) == "#" * 30
     assert run_report._bar(250.0) == "#" * 30
     assert len(run_report._bar(33.3)) == 30
+
+
+def _canned_serve_workdir(tmp_path):
+    """A workdir as a fleet-3 chaos loadgen run leaves it: SLO summary,
+    BENCH record, and a slow-request exemplar dump."""
+    from rt1_tpu.obs.recorder import ExemplarRing
+    from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+
+    wd = tmp_path / "serve-run"
+    wd.mkdir()
+    ledger = SLOLedger(SLOObjectives(availability=0.99))
+    for _ in range(996):
+        ledger.observe("ok", 0.012)
+    ledger.observe("restarted", 0.150)
+    ledger.observe("restarted", 0.200)
+    ledger.observe("rejected", 0.001)
+    ledger.observe("failed", 0.0)
+    ledger.write_summary(str(wd / "slo_summary.json"))
+
+    bench = {
+        "metric": "serve_requests_per_sec",
+        "value": 93.5,
+        "unit": "req/s",
+        "requests_ok": 996,
+        "requests_restarted": 2,
+        "requests_rejected": 1,
+        "requests_failed": 1,
+        "fleet_replicas": 3,
+        "faults": "replica_kill@1,serve_reload@2",
+        "replica_restarts_total": 1,
+        "replica_compile_counts": [1, 1, 1],
+        "replicas_ready_at_end": 3,
+    }
+    with open(wd / "BENCH_serve_fleet.json", "w") as f:
+        json.dump(bench, f)
+
+    ring = ExemplarRing(capacity=8, threshold_ms=50.0)
+    ring.offer(
+        151.2,
+        request_id="slowest-one",
+        session="s3",
+        outcome="restarted",
+        phases={"queue_wait_ms": 80.0, "device_ms": 60.0},
+    )
+    ring.offer(
+        72.0,
+        request_id="also-slow",
+        session="s1",
+        outcome="ok",
+        phases={"queue_wait_ms": 40.0, "device_ms": 30.0},
+    )
+    ring.dump(str(wd / "slow_requests.jsonl"), reason="supervisor_scrape")
+    return str(wd)
+
+
+def test_serve_postmortem_section(tmp_path):
+    """The serve post-mortem: SLO verdict + outcome table + fleet/chaos
+    evidence + slowest exemplars, merged from the serving artifacts."""
+    wd = _canned_serve_workdir(tmp_path)
+    serve = run_report.load_serve(wd)
+    assert serve is not None
+    report = run_report.render_report(wd, None, None, None, serve=serve)
+
+    assert "## Serve post-mortem (SLO ledger)" in report
+    # Verdict numbers: 996/1000 ok -> 99.6% availability vs 99% objective
+    # -> 40% of the error budget burned; SLO met.
+    assert "Availability 99.600%" in report
+    assert "error budget burned 40.0%" in report
+    assert "Objectives: availability >= 0.99" in report
+    assert "SLO met." in report
+    # Outcome table rows with per-class budget burn.
+    lines = report.splitlines()
+    ok_row = next(ln for ln in lines if ln.startswith("ok "))
+    assert "996" in ok_row
+    restarted_row = next(ln for ln in lines if ln.startswith("restarted"))
+    assert "2" in restarted_row and "20.0%" in restarted_row
+    # Fleet/chaos evidence from the BENCH record.
+    assert "Loadgen: 93.5 req/s — 996 ok, 2 restarted, 1 rejected," in report
+    assert "Fleet: 3 replicas" in report
+    assert "replica_kill@1,serve_reload@2" in report
+    assert "compile counts [1, 1, 1]" in report
+    # Exemplars: slowest first, with phase columns.
+    assert "Slow-request exemplars: 2 retained" in report
+    assert "(threshold 50.0 ms" in report
+    slowest = next(ln for ln in lines if ln.startswith("slowest-one"))
+    also = next(ln for ln in lines if ln.startswith("also-slow"))
+    assert lines.index(slowest) < lines.index(also)
+    assert "151.20" in slowest and "80.00" in slowest and "60.00" in slowest
+    assert slowest.rstrip().endswith("restarted")
+
+
+def test_serve_section_absent_for_training_only_run(tmp_path):
+    """A pure training workdir renders NO serve section — the golden
+    training report stays byte-stable."""
+    wd = _canned_workdir(tmp_path)
+    assert run_report.load_serve(wd) is None
+    report = run_report.render_report(
+        wd, run_report.load_goodput(wd), run_report.load_flight(wd), None
+    )
+    assert "Serve post-mortem" not in report
+
+
+def test_slo_violation_renders_loudly(tmp_path):
+    """An out-of-objective run must say so, naming the violated axis."""
+    from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+
+    wd = tmp_path / "bad-run"
+    wd.mkdir()
+    ledger = SLOLedger(SLOObjectives(availability=0.99))
+    for _ in range(90):
+        ledger.observe("ok", 0.010)
+    for _ in range(10):
+        ledger.observe("failed", 0.0)
+    ledger.write_summary(str(wd / "slo_summary.json"))
+    report = run_report.render_report(
+        str(wd), None, None, None, serve=run_report.load_serve(str(wd))
+    )
+    assert "SLO VIOLATED — availability outside objective." in report
+
+
+def test_main_renders_serve_section(tmp_path, capsys):
+    wd = _canned_serve_workdir(tmp_path)
+    run_report.main(["--workdir", wd])
+    out = capsys.readouterr().out
+    assert "Serve post-mortem" in out
+    assert "Availability 99.600%" in out

@@ -139,6 +139,133 @@ def test_rolling_reload_hits_every_replica(fleet):
     assert status == 200
 
 
+def test_request_id_propagates_end_to_end(fleet):
+    """ISSUE acceptance: ONE request id appears in the router's
+    `router_route` span, the replica's `replica_act` span (read back via
+    the stub's /trace introspection endpoint), and the response's phase
+    breakdown — client-supplied header honored throughout."""
+    from rt1_tpu.obs import trace as obs_trace
+
+    router, _, url = fleet
+    rid = "e2e-propagation-id"
+    tracer = obs_trace.enable(max_events=256)
+    try:
+        req = urllib.request.Request(
+            url + "/act",
+            data=json.dumps(
+                {
+                    "session_id": "traced-sess",
+                    "image_b64": "AAAA",
+                    "instruction": "x",
+                    "debug": True,
+                }
+            ).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "X-RT1-Request-Id": rid,
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            body = json.loads(resp.read())
+        # 1. The response: id echoed at top level AND inside the phase
+        #    breakdown, with the stub's device step actually measured.
+        assert body["request_id"] == rid
+        assert body["phases"]["request_id"] == rid
+        assert body["phases"]["device_ms"] is not None
+        # 2. The router-side span (this process) carries the same id.
+        events = tracer.to_dict()["traceEvents"]
+        route_spans = [
+            e for e in events
+            if e.get("name") == "router_route"
+            and e.get("args", {}).get("request_id") == rid
+        ]
+        assert len(route_spans) == 1
+        assert route_spans[0]["args"]["session"] == "traced-sess"
+    finally:
+        obs_trace.disable()
+    # 3. The replica-side spans (stub subprocess) carry it too: the
+    #    header crossed the HTTP hop.
+    replica = next(
+        r for r in router.replicas()
+        if r.id == body["replica_id"]
+    )
+    status, trace_body = _get(replica.url + "/trace")
+    assert status == 200
+    names = {
+        e["name"]
+        for e in trace_body["traceEvents"]
+        if e.get("args", {}).get("request_id") == rid
+        or rid in (e.get("args", {}).get("request_ids") or [])
+    }
+    assert "replica_act" in names
+    assert "device_step" in names
+
+
+def test_fleet_metrics_aggregation_json_and_prometheus(fleet):
+    """One scrape target for the whole fleet: the router's /metrics
+    carries every live replica's snapshot under `replicas` (JSON) and as
+    `rt1_serve_replica_*{replica_id="N"}` labeled families (text), plus
+    the SLO ledger's gauges in both formats."""
+    router, _, url = fleet
+    status, body = _get(url + "/metrics")
+    assert status == 200
+    # JSON: both replicas present with their full per-replica view.
+    assert set(body["replicas"].keys()) == {"0", "1"}
+    for rid, snap in body["replicas"].items():
+        assert snap is not None, f"replica {rid} probe failed"
+        assert snap["compile_count"] == 1
+        assert snap["replica_id"] == int(rid)
+        assert "requests_total" in snap and "queue_depth" in snap
+    # SLO gauges ride the same scrape.
+    assert body["slo_requests_total"] > 0
+    assert 0.0 <= body["slo_availability"] <= 1.0
+    assert body["slo_objective_availability"] == 0.99
+
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode("utf-8")
+    # Per-replica labeled families, one sample per live replica.
+    for rid in ("0", "1"):
+        assert f'rt1_serve_replica_up{{replica_id="{rid}"}} 1' in text
+        assert (
+            f'rt1_serve_replica_compile_count{{replica_id="{rid}"}} 1'
+            in text
+        )
+        assert f'rt1_serve_replica_requests_total{{replica_id="{rid}"}}' in text
+    assert "# TYPE rt1_serve_replica_up gauge" in text
+    assert "# TYPE rt1_serve_replica_requests_total counter" in text
+    # SLO families render under the serve prefix.
+    assert "rt1_serve_slo_availability" in text
+    assert "rt1_serve_slo_error_budget_burn" in text
+
+
+def test_slo_endpoint_and_fleet_slow_requests(fleet):
+    """GET /slo returns the ledger's full judgement; GET
+    /fleet/slow_requests fans the exemplar rings out of every replica."""
+    _, _, url = fleet
+    status, slo = _get(url + "/slo")
+    assert status == 200
+    assert slo["requests_total"] > 0
+    assert set(slo["by_class"]) == {"ok", "restarted", "rejected", "failed"}
+    assert "error_budget_burn" in slo
+    status, body = _get(url + "/fleet/slow_requests")
+    assert status == 200
+    assert set(body["replicas"].keys()) == {"0", "1"}
+    # The traced request from the propagation test is on file in some
+    # replica's ring, phase breakdown included.
+    all_ids = {
+        rec["request_id"]
+        for scrape in body["replicas"].values()
+        if scrape
+        for rec in scrape.get("slow_requests", [])
+    }
+    assert "e2e-propagation-id" in all_ids
+
+
 def test_replica_kill_rehomes_sessions_with_restarted_flag(fleet):
     """The headline semantics: SIGKILL a replica mid-conversation; every
     session homed there re-homes to the live replica on its next /act —
@@ -181,6 +308,14 @@ def test_replica_kill_rehomes_sessions_with_restarted_flag(fleet):
         assert body["step_index"] == 3
     snapshot = router.metrics_snapshot()
     assert snapshot["sessions_restarted_total"] == len(on_target)
+    # SLO ledger: each failover landed in the `restarted` bucket — an
+    # answered request that burned error budget, not an outage — and the
+    # burn is now visibly nonzero while availability stays high.
+    gauges = router.slo.gauges()
+    assert gauges["slo_requests_restarted"] == float(len(on_target))
+    assert gauges["slo_requests_failed"] == 0.0
+    assert gauges["slo_error_budget_burn"] > 0.0
+    assert gauges["slo_availability"] < 1.0
 
     # The supervisor respawns the replica (fresh process, warm-up gated)
     # and the fleet heals back to 2-ready.
@@ -230,3 +365,19 @@ def test_fleet_chaos_loadgen_real_replicas(tmp_path):
     assert result["replica_restarts_total"] == 1
     # One XLA compile per replica lifetime, kill + respawn included.
     assert all(c == 1 for c in result["replica_compile_counts"])
+    # SLO ledger rides the BENCH record: the kill+reload scenario burns
+    # nonzero error budget (the restarted requests) while availability
+    # stays above the objective — degraded, within contract.
+    slo = result["slo"]
+    assert slo["by_class"]["restarted"]["count"] >= 1
+    assert slo["by_class"]["failed"]["count"] == 0
+    assert slo["error_budget_burn"] > 0.0
+    assert slo["availability"] >= slo["objectives"]["availability"]
+    assert slo["availability_within_objective"] is True
+    # The router kept its own (server-side) ledger; it saw the same
+    # restarted requests.
+    assert result["server_slo"]["by_class"]["restarted"]["count"] >= 1
+    # slo_summary.json artifact written next to --output for run_report.
+    summary_path = output.parent / "slo_summary.json"
+    assert summary_path.exists()
+    assert json.loads(summary_path.read_text()) == slo

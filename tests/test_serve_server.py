@@ -277,6 +277,145 @@ def test_metrics_fleet_gauges_present(serving_stack):
     assert "rt1_serve_replica_id 0" in text
 
 
+def test_request_id_echo_and_debug_phases(serving_stack):
+    """Request tracing on the REAL engine path: a client-supplied
+    X-RT1-Request-Id round-trips in the response, and `debug: true`
+    returns the per-phase breakdown carrying the same id with every
+    pipeline phase actually stamped (admission through serialization)."""
+    _, _, _, url = serving_stack
+    frame = np.zeros((H, W, 3), np.float32).tolist()
+    payload = {
+        "session_id": "traced",
+        "image": frame,
+        "instruction": "push the red moon to the blue cube",
+        "debug": True,
+    }
+    req = urllib.request.Request(
+        url + "/act",
+        data=json.dumps(payload).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-RT1-Request-Id": "client-chosen-id",
+        },
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    assert body["request_id"] == "client-chosen-id"
+    phases = body["phases"]
+    assert phases["request_id"] == "client-chosen-id"
+    # Every boundary the request crossed is a real (>= 0) measurement,
+    # and the parts are bounded by the whole.
+    for key in (
+        "admission_ms", "queue_wait_ms", "batch_form_ms",
+        "device_ms", "serialize_ms", "total_ms",
+    ):
+        assert phases[key] is not None and phases[key] >= 0.0, key
+    parts = (
+        phases["admission_ms"] + phases["queue_wait_ms"]
+        + phases["batch_form_ms"] + phases["device_ms"]
+        + phases["serialize_ms"]
+    )
+    assert parts == pytest.approx(phases["total_ms"], abs=1.0)
+    # Without the debug flag the breakdown stays server-side...
+    del payload["debug"]
+    status, body = _post(url + "/act", payload)
+    assert status == 200
+    assert "phases" not in body
+    assert len(body["request_id"]) == 16  # minted when no client id
+    _post(url + "/release", {"session_id": "traced"})
+
+
+def test_slow_requests_exemplar_endpoint(serving_stack):
+    """...but it lands in the exemplar ring regardless: GET
+    /slow_requests names recent requests with their phase breakdowns,
+    including failed ones (400s carry an outcome + error)."""
+    app, _, _, url = serving_stack
+    status, body = _get(url + "/slow_requests")
+    assert status == 200
+    assert body["capacity"] == 128
+    recorded = {r["request_id"] for r in body["slow_requests"]}
+    assert "client-chosen-id" in recorded
+    by_id = {r["request_id"]: r for r in body["slow_requests"]}
+    rec = by_id["client-chosen-id"]
+    assert rec["outcome"] == "ok"
+    assert rec["session"] == "traced"
+    assert rec["phases"]["device_ms"] >= 0.0
+    assert rec["total_ms"] >= rec["phases"]["device_ms"]
+    # A 400 (no image) is an exemplar too — failures are exactly what a
+    # post-mortem wants on file.
+    status, body = _post(
+        url + "/act", {"session_id": "exemplar-fail"}
+    )
+    assert status == 400
+    failed_id = body["request_id"]
+    _, body = _get(url + "/slow_requests")
+    by_id = {r["request_id"]: r for r in body["slow_requests"]}
+    assert by_id[failed_id]["outcome"] == "failed"
+    assert "image" in by_id[failed_id]["error"]
+    # Unreached phases are None in the failed exemplar, not zeros.
+    assert by_id[failed_id]["phases"]["device_ms"] is None
+
+
+class _InstantEngine:
+    """Model-free engine double: the exact attribute/act_batch surface
+    ServeApp touches, with zero-latency steps — lets a drain-path test
+    run without a jax boot (the module fixture's app must stay alive for
+    later tests, so it cannot be drained here)."""
+
+    max_sessions = 8
+    active_sessions = 0
+    compile_count = 1
+    reloads = 0
+    embed_calls = 0
+    evictions = 0
+
+    def warmup(self, image_shape, embed_dim):
+        pass
+
+    def act_batch(self, items):
+        return [
+            {"action": [0.0, 0.0], "action_tokens": [0, 0, 0]}
+            for _ in items
+        ]
+
+
+def test_exemplar_ring_dumped_on_drain(tmp_path):
+    """The serve-side flight-recorder semantics: a replica that drains
+    (the SIGTERM path) leaves its exemplar ring on disk for run_report,
+    through ServeApp's own drain hook."""
+    from rt1_tpu.obs.recorder import read_exemplars
+    from rt1_tpu.serve import reqtrace
+    from rt1_tpu.serve.server import ServeApp
+
+    path = str(tmp_path / "slow_requests.jsonl")
+    app = ServeApp(
+        _InstantEngine(),
+        image_shape=(H, W, 3),
+        embed_dim=D,
+        exemplar_path=path,
+    )
+    app.start(warmup=False)
+    phases = reqtrace.RequestPhases("pre-drain")
+    result = app.act("drain-sess", {"image": None}, phases)
+    assert result["action"] == [0.0, 0.0]
+    # The handler normally offers post-act; the drain dump only writes
+    # what the ring holds, so record the finished request as _act does.
+    app.exemplars.offer(
+        phases.phases_ms()["total_ms"],
+        request_id=phases.request_id,
+        outcome="ok",
+        phases=phases.phases_ms(),
+    )
+    app.drain(timeout=10.0)
+    loaded = read_exemplars(path)
+    assert loaded["header"]["reason"] == "drain"
+    assert [r["request_id"] for r in loaded["records"]] == ["pre-drain"]
+    # The batcher stamped the cross-thread boundaries on the way through.
+    assert loaded["records"][0]["phases"]["queue_wait_ms"] is not None
+    assert loaded["records"][0]["phases"]["device_ms"] is not None
+
+
 def test_reload_endpoint_requires_a_source(serving_stack):
     """The module app has no reload_fn: POST /reload is a clean 400, not
     a crash."""
