@@ -109,7 +109,21 @@ def serving_plan(config):
     )
 
 
-def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
+def _config_with_model_dtype(config, dtype: str):
+    """A deep copy of `config` with `model.dtype` overridden — the bf16
+    serving mode rebuilds the model at the bf16 COMPUTE dtype while the
+    checkpoint (and therefore restore) stays at the f32 master dtype."""
+    import copy
+
+    cfg = copy.deepcopy(config)
+    with cfg.unlocked():
+        cfg.model.dtype = dtype
+    return cfg
+
+
+def build_serve_engine(
+    config, workdir=None, step=None, inference_dtype="f32", **engine_kwargs
+):
     """Feed a checkpoint (or random init when `workdir` is None) into a
     multi-session serving engine. Returns (engine, checkpoint_step);
     checkpoint_step is -1 for random init.
@@ -118,9 +132,33 @@ def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
     engine places every leaf per the plan rule on the serve mesh, so a
     tensor-parallel or fsdp-sharded engine is the same config switch as in
     training — no per-callsite spec plumbing.
+
+    ``inference_dtype`` selects the low-precision serving mode
+    (rt1_tpu/models/quant.py; docs/serving.md "Low-precision serving"):
+
+    * ``"f32"``  — today's path, byte-identical placement and compute.
+    * ``"bf16"`` — the model is rebuilt at bf16 compute dtype and every
+      float leaf is cast ONCE at restore (bit-identical to flax's own
+      at-use cast, half the resident bytes).
+    * ``"int8"`` — the quant plan's int8 group (parallel/plan.py
+      `rt1_quant_rules`: FiLM-EfficientNet convs + transformer matmuls)
+      quantizes per-output-channel on the host; norms, embeddings, the
+      action head, and BN stats stay f32. Dequant `(w_int8 * scale) @ x`
+      fuses into the matmuls.
+
+    In bf16/int8 mode the engine keeps the master spec + the preparer, so
+    `swap_variables` (POST /reload, fleet rolling reload) revalidates and
+    requantizes every standby f32 checkpoint — compile_count stays 1.
     """
+    from rt1_tpu.models.quant import (
+        check_inference_dtype,
+        serving_preparer,
+    )
     from rt1_tpu.serve.engine import PolicyEngine
 
+    check_inference_dtype(inference_dtype)
+    if inference_dtype == "bf16":
+        config = _config_with_model_dtype(config, "bfloat16")
     if workdir is None:
         model, state, family, _ = build_model_and_state(config)
         variables, restored_step = _variables_from_state(state), -1
@@ -133,12 +171,31 @@ def build_serve_engine(config, workdir=None, step=None, **engine_kwargs):
             f"the serving engine batches RT-1 rolling network state; "
             f"family={family!r} is not servable (use the eval harness)"
         )
+    prepare = serving_preparer(inference_dtype)
+    master_variables = None
+    if prepare is not None:
+        import jax
+        import numpy as np
+
+        # Quantize/cast ON THE HOST from the f32 masters; the engine keeps
+        # the master spec so reloads validate against the checkpoint
+        # contract, not the serving dtypes.
+        master_variables = jax.tree.map(lambda x: np.asarray(x), variables)
+        variables = prepare(master_variables)
     if "plan" not in engine_kwargs:
         # Resolved lazily: an explicitly passed plan (or plan=None for
         # plain placement) must not trigger serving_plan's device-count
         # validation for a layout that will never be built.
         engine_kwargs["plan"] = serving_plan(config)
-    return PolicyEngine(model, variables, **engine_kwargs), restored_step
+    engine = PolicyEngine(
+        model,
+        variables,
+        inference_dtype=inference_dtype,
+        prepare_variables=prepare,
+        master_variables=master_variables,
+        **engine_kwargs,
+    )
+    return engine, restored_step
 
 
 def load_standby_variables(config, workdir=None, step=None):

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from rt1_tpu.models.film import FilmConditioning
+from rt1_tpu.models.quant import QuantConv
 
 # Table-1 base (B0) config; film_efficientnet_encoder.py:36-99.
 BLOCKS_ARGS: Tuple[Dict[str, Any], ...] = (
@@ -86,7 +87,10 @@ class ConvNormAct(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
         pad = (self.kernel_size - 1) // 2
-        x = nn.Conv(
+        # QuantConv == nn.Conv until an int8 serving tree arrives
+        # (models/quant.py; conv kernels are the int8 group in
+        # parallel/plan.py rt1_quant_rules — BN stays full precision).
+        x = QuantConv(
             self.features,
             (self.kernel_size, self.kernel_size),
             strides=(self.strides, self.strides),
@@ -120,9 +124,9 @@ class SqueezeExcite(nn.Module):
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         se_size = max(1, int(self.block_in_size * self.se_ratio))
         s = jnp.mean(x, axis=(-3, -2), keepdims=True)
-        s = nn.Conv(se_size, (1, 1), use_bias=True, dtype=self.dtype, name="fc1")(s)
+        s = QuantConv(se_size, (1, 1), use_bias=True, dtype=self.dtype, name="fc1")(s)
         s = nn.silu(s)
-        s = nn.Conv(self.expand_size, (1, 1), use_bias=True, dtype=self.dtype, name="fc2")(s)
+        s = QuantConv(self.expand_size, (1, 1), use_bias=True, dtype=self.dtype, name="fc2")(s)
         s = nn.sigmoid(s)
         return x * s
 

@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from rt1_tpu.models.film import FilmConditioning
+from rt1_tpu.models.quant import QuantConv
 
 
 class EfficientNetEncoder(nn.Module):
@@ -51,7 +52,9 @@ class EfficientNetEncoder(nn.Module):
             features = net(image, context=context, train=train)
         else:
             features = net(image, train=train)
-        features = nn.Conv(
+        # QuantConv == nn.Conv until an int8 serving tree arrives
+        # (models/quant.py).
+        features = QuantConv(
             self.token_embedding_size,
             (1, 1),
             use_bias=False,

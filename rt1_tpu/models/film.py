@@ -13,6 +13,8 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from rt1_tpu.models.quant import QuantDense
+
 
 class FilmConditioning(nn.Module):
     num_channels: int
@@ -20,14 +22,17 @@ class FilmConditioning(nn.Module):
 
     @nn.compact
     def __call__(self, conv_filters: jnp.ndarray, conditioning: jnp.ndarray) -> jnp.ndarray:
-        proj_add = nn.Dense(
+        # QuantDense == nn.Dense until an int8 serving tree arrives; the
+        # zero-init projections round-trip exactly (quantize_per_channel
+        # maps an all-zero channel to scale 1.0).
+        proj_add = QuantDense(
             self.num_channels,
             kernel_init=nn.initializers.zeros,
             bias_init=nn.initializers.zeros,
             dtype=self.dtype,
             name="projection_add",
         )(conditioning)
-        proj_mult = nn.Dense(
+        proj_mult = QuantDense(
             self.num_channels,
             kernel_init=nn.initializers.zeros,
             bias_init=nn.initializers.zeros,

@@ -87,11 +87,18 @@ class MoEFeedForward(nn.Module):
         expert_init = nn.initializers.variance_scaling(
             1.0, "fan_in", "truncated_normal", batch_axis=(0,)
         )
-        wi = self.param(
-            "wi", expert_init, (e, d, ff), jnp.float32
+        # maybe_dequantize: the int8 serving path (models/quant.py) — a
+        # no-op on f32/bf16 trees; the fp32 router above is never
+        # quantized (parallel/plan.py rt1_quant_rules).
+        from rt1_tpu.models.quant import maybe_dequantize
+
+        wi = maybe_dequantize(
+            self, self.param("wi", expert_init, (e, d, ff), jnp.float32),
+            "wi_scale",
         ).astype(self.dtype)
-        wo = self.param(
-            "wo", expert_init, (e, ff, d), jnp.float32
+        wo = maybe_dequantize(
+            self, self.param("wo", expert_init, (e, ff, d), jnp.float32),
+            "wo_scale",
         ).astype(self.dtype)
 
         dispatch = dispatch.astype(self.dtype)

@@ -9,22 +9,31 @@ to `num_tokens` embedding tokens.
 import flax.linen as nn
 import jax.numpy as jnp
 
+from rt1_tpu.models.quant import QuantConv, QuantDense
+
 
 class TinyImageTokenizer(nn.Module):
     num_tokens: int = 2
     emb: int = 16
+    # Compute dtype, threaded from config.model.dtype like the B3 tower's —
+    # the bf16 serving mode needs the tiny tokenizer to honor it so tier-1
+    # can pin bf16-restore ≡ bf16-compute on the smoke config.
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, image, context=None, train=False):
         b, t, h, w, c = image.shape
         x = image.reshape(b * t, h, w, c)
-        x = nn.Conv(8, (3, 3), strides=(2, 2), name="conv")(x)
+        # Quant layers == stock flax until an int8 serving tree arrives
+        # (models/quant.py) — keeps the tiny config exercising the same
+        # quantized-serving path as the flagship in tier-1.
+        x = QuantConv(8, (3, 3), strides=(2, 2), dtype=self.dtype, name="conv")(x)
         x = nn.relu(x)
         x = jnp.mean(x, axis=(1, 2))  # (b*t, 8)
         if context is not None:
             ctx = context.reshape(b * t, -1)
             x = jnp.concatenate(
-                [x, nn.Dense(8, name="ctx_proj")(ctx)], axis=-1
+                [x, QuantDense(8, dtype=self.dtype, name="ctx_proj")(ctx)], axis=-1
             )
-        tokens = nn.Dense(self.num_tokens * self.emb, name="tok")(x)
+        tokens = QuantDense(self.num_tokens * self.emb, dtype=self.dtype, name="tok")(x)
         return tokens.reshape(b, t, self.num_tokens, self.emb)

@@ -14,6 +14,8 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
+from rt1_tpu.models.quant import QuantConv
+
 
 class TokenLearner(nn.Module):
     num_tokens: int = 8
@@ -25,11 +27,12 @@ class TokenLearner(nn.Module):
     def __call__(self, inputs: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         b, h, w, c = inputs.shape
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(inputs)
-        x = nn.Conv(self.bottleneck_dim, (1, 1), dtype=self.dtype, name="conv1")(x)
+        # QuantConv == nn.Conv until an int8 serving tree arrives.
+        x = QuantConv(self.bottleneck_dim, (1, 1), dtype=self.dtype, name="conv1")(x)
         x = nn.gelu(x, approximate=True)  # reference uses GELU(approximate='tanh') (:43)
         if self.dropout_rate > 0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.Conv(self.num_tokens, (1, 1), dtype=self.dtype, name="conv2")(x)
+        x = QuantConv(self.num_tokens, (1, 1), dtype=self.dtype, name="conv2")(x)
         if self.dropout_rate > 0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         # (B, H, W, T) → (B, T, H*W) softmax-normalized spatial attention maps.

@@ -26,6 +26,8 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from rt1_tpu.models.quant import QuantDense
+
 NEG_INF = -1e9
 
 
@@ -61,9 +63,12 @@ class TFMultiHeadAttention(nn.Module):
     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         b, s, _ = x.shape
         h, k = self.num_heads, self.key_dim
-        q = nn.Dense(h * k, dtype=self.dtype, name="query")(x).reshape(b, s, h, k)
-        kk = nn.Dense(h * k, dtype=self.dtype, name="key")(x).reshape(b, s, h, k)
-        v = nn.Dense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
+        # QuantDense == nn.Dense until an int8 serving tree arrives
+        # (models/quant.py); qkv/out/ff are the int8 group in the quant
+        # plan (parallel/plan.py rt1_quant_rules).
+        q = QuantDense(h * k, dtype=self.dtype, name="query")(x).reshape(b, s, h, k)
+        kk = QuantDense(h * k, dtype=self.dtype, name="key")(x).reshape(b, s, h, k)
+        v = QuantDense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
 
         import jax as _jax
 
@@ -89,7 +94,7 @@ class TFMultiHeadAttention(nn.Module):
                 interpret=_jax.default_backend() != "tpu",
             )
             out = out.reshape(b, s, h * k)
-            return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), None
+            return QuantDense(self.d_model, dtype=self.dtype, name="out")(out), None
 
         use_ring = (
             self.attention_impl == "ring"
@@ -110,7 +115,7 @@ class TFMultiHeadAttention(nn.Module):
                 scale=1.0 / float(k) ** 0.5,
             )
             out = out.reshape(b, s, h * k)
-            return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), None
+            return QuantDense(self.d_model, dtype=self.dtype, name="out")(out), None
 
         # (b, h, sq, sk) attention logits; fp32 softmax for stability under bf16.
         logits = jnp.einsum("bshd,bthd->bhst", q, kk, preferred_element_type=jnp.float32)
@@ -126,7 +131,7 @@ class TFMultiHeadAttention(nn.Module):
         probs = nn.Dropout(self.dropout_rate, deterministic=not train)(probs)
         out = jnp.einsum("bhst,bthd->bshd", probs.astype(self.dtype), v)
         out = out.reshape(b, s, h * k)
-        return nn.Dense(self.d_model, dtype=self.dtype, name="out")(out), probs
+        return QuantDense(self.d_model, dtype=self.dtype, name="out")(out), probs
 
 
 class TransformerLayer(nn.Module):
@@ -180,7 +185,7 @@ class TransformerLayer(nn.Module):
             )(y)
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
-            y = nn.Dense(self.d_model, dtype=self.dtype, name="ff")(y)
+            y = QuantDense(self.d_model, dtype=self.dtype, name="ff")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return x + y, scores
 

@@ -184,6 +184,17 @@ def _render_serve_into(
         if key in snapshot:
             exp.counter(prefix + key, snapshot[key], help_text)
             consumed.add(key)
+    # The engine's low-precision mode is a string, exposed info-style
+    # (`rt1_serve_inference_dtype{dtype="int8"} 1`) so dashboards can
+    # group latency by dtype without an enum-code mapping.
+    if isinstance(snapshot.get("inference_dtype"), str):
+        exp.family(
+            prefix + "inference_dtype",
+            "gauge",
+            [({"dtype": snapshot["inference_dtype"]}, 1.0)],
+            "Engine inference dtype (f32 | bf16 | int8), info-style.",
+        )
+        consumed.add("inference_dtype")
     for key, (family, help_text) in _SERVE_HISTOGRAMS.items():
         buckets = snapshot.get(f"{key}_buckets")
         if buckets is None:
@@ -237,13 +248,21 @@ _FLEET_REPLICA_FIELDS = {
     "session_evictions": ("gauge", "LRU slot reclaims (oversubscription)."),
     "slow_exemplars": ("gauge", "Slow-request exemplars retained."),
     "uptime_s": ("gauge", "Replica process uptime (seconds)."),
+    "param_bytes_device": (
+        "gauge",
+        "Device-resident serving-tree bytes (int8 quantized size counts).",
+    ),
+    "param_bytes_master": (
+        "gauge",
+        "f32 master checkpoint bytes this replica restores from.",
+    ),
 }
 
 
 def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
     """Every family name the aggregated fleet exposition can emit (the
     naming-contract test iterates this)."""
-    names = [prefix + "replica_up"]
+    names = [prefix + "replica_up", prefix + "replica_inference_dtype"]
     for key in _FLEET_REPLICA_FIELDS:
         names.append(prefix + "replica_" + _gauge_suffix(key))
     return names
@@ -275,6 +294,24 @@ def render_fleet_snapshot(
             "gauge",
             up,
             "1 when the replica's /metrics answered the fan-out probe.",
+        )
+    # Mixed-dtype fleets: each replica's inference dtype as one labeled
+    # info family — `{replica_id="1",dtype="int8"} 1` — so a per-dtype
+    # latency dashboard needs no enum mapping.
+    dtype_samples = [
+        (
+            {"replica_id": str(rid), "dtype": snap["inference_dtype"]},
+            1.0,
+        )
+        for rid, snap in sorted(replicas.items(), key=lambda kv: str(kv[0]))
+        if snap is not None and isinstance(snap.get("inference_dtype"), str)
+    ]
+    if dtype_samples:
+        exp.family(
+            prefix + "replica_inference_dtype",
+            "gauge",
+            dtype_samples,
+            "Replica inference dtype (f32 | bf16 | int8), info-style.",
         )
     for key, (mtype, help_text) in _FLEET_REPLICA_FIELDS.items():
         samples = [

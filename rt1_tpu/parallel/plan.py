@@ -128,6 +128,91 @@ def rt1_sharding_plan() -> List[Rule]:
     ]
 
 
+# --------------------------------------------------------------- quant plan
+#
+# Quantization groups for the low-precision serving engine
+# (rt1_tpu/models/quant.py): the SAME path-regex machinery as the sharding
+# rules above, so "what gets int8" is declared next to "how it shards"
+# (SNIPPETS.md [3]'s sharding map carries torch.int8 dtypes per entry for
+# exactly this reason). First match wins; an unmatched path serves at the
+# master dtype. Groups:
+QUANT_INT8 = "int8"   # per-output-channel int8 weights + f32 scale sidecar
+QUANT_F32 = "f32"     # never quantized (master/compute dtype)
+
+
+def rt1_quant_rules() -> List[Tuple[str, str]]:
+    """THE quant plan: ordered (path-regex, group) over every RT-1 param
+    group. int8 covers the matmul/conv weights whose bytes dominate the
+    serving tree — transformer qkv/out/FFN, MoE experts, FiLM projections,
+    every EfficientNet/SE/TokenLearner/encoder conv, and the tiny
+    tokenizer's projections. Embeddings, the action head (`output_tokens`
+    IS the action decode), norms, biases, BN statistics, and the fp32 MoE
+    router are listed f32 EXPLICITLY — `quant_coverage` distinguishes
+    "decided full-precision" from "forgotten", same philosophy as the
+    sharding plan's coverage check.
+    """
+    return [
+        # --- explicit full-precision: embeddings + the action head -------
+        (r"transformer/(token_emb|position_emb|output_tokens)/", QUANT_F32),
+        # fp32 router: routing decisions must not flip under quant noise.
+        (r"moe/gate/kernel$", QUANT_F32),
+        # Vision-pretrain classifier head (dropped before policy serving,
+        # but the rule set must decide every path it can meet).
+        (r"classifier/", QUANT_F32),
+        # Norm/BN leaves are rank<2 (never quantizable) — listed anyway so
+        # the decision is readable here, not implied by rank.
+        (r"(norm_\d+|norm|bn)/(scale|bias|mean|var)$", QUANT_F32),
+        # --- int8: transformer decoder matmuls ---------------------------
+        (r"transformer/layer_\d+/attn/(query|key|value|out)/kernel$",
+         QUANT_INT8),
+        (r"transformer/layer_\d+/ff/kernel$", QUANT_INT8),
+        # Stacked Switch-MoE experts (E, d, ff)/(E, ff, d): per-channel on
+        # the output dim, scales shared across experts (conservative).
+        (r"moe/(wi|wo)$", QUANT_INT8),
+        # --- int8: FiLM-EfficientNet tokenizer ---------------------------
+        (r"projection_(add|mult)/kernel$", QUANT_INT8),
+        # Conv kernels (stem/top/expand/project/depthwise, SE fc1/fc2,
+        # encoder conv1x1, TokenLearner conv1/conv2, tiny stem conv).
+        (r"(conv|conv1|conv2|conv1x1|fc1|fc2)/kernel$", QUANT_INT8),
+        # --- int8: tiny tokenizer projections ----------------------------
+        (r"image_tokenizer_def/(ctx_proj|tok)/kernel$", QUANT_INT8),
+    ]
+
+
+def quant_group_for_path(
+    path_str: str, rules: Optional[Sequence[Tuple[str, str]]] = None
+) -> str:
+    """First matching quant rule's group; unmatched paths serve at the
+    master dtype (QUANT_F32)."""
+    if rules is None:
+        rules = rt1_quant_rules()
+    for pattern, group in rules:
+        if re.search(pattern, path_str):
+            return group
+    return QUANT_F32
+
+
+def quant_coverage(
+    tree: Any, rules: Optional[Sequence[Tuple[str, str]]] = None
+) -> List[str]:
+    """Paths of rank>=2 leaves no quant rule decided (fell through to the
+    master-dtype default). Mirrors `ShardingPlan.coverage`: a weight
+    matrix nobody DECIDED about is how a renamed module quietly loses its
+    3x memory win — tier-1 pins this empty for the shipped configs."""
+    from rt1_tpu.parallel import sharding as shardlib
+
+    if rules is None:
+        rules = rt1_quant_rules()
+    undecided = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if getattr(leaf, "ndim", 0) < 2:
+            continue
+        s = shardlib._path_str(path)
+        if not any(re.search(pattern, s) for pattern, _ in rules):
+            undecided.append(s)
+    return undecided
+
+
 # Plan-level placement for the stacked per-layer tree pipeline_apply shards
 # over `stage`. The explicit replicated pin is load-bearing on XLA:CPU
 # (jax 0.4.x): a stack/concatenate of per-layer params resharded straight
@@ -220,6 +305,12 @@ class ShardingPlan:
         return NamedSharding(self.mesh, P())
 
     # ------------------------------------------------------------ matching
+    def quant_group(self, path_str: str) -> str:
+        """The quantization group for a param path (module-level quant
+        rules; on the plan so layout consumers read shard + quant
+        decisions from one object)."""
+        return quant_group_for_path(path_str)
+
     def spec_for(self, path_str: str) -> Optional[P]:
         """First matching rule's spec, or None (≠ P()!) when unmatched."""
         for pattern, spec in self.rules:

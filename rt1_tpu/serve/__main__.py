@@ -105,6 +105,7 @@ def main(argv):
     engine, step = build_serve_engine(
         config,
         workdir=None if FLAGS.random_init else FLAGS.workdir,
+        inference_dtype=FLAGS.inference_dtype,
         max_sessions=FLAGS.max_sessions,
         embedder=get_embedder(FLAGS.embedder),
     )
@@ -152,6 +153,8 @@ def main(argv):
                 "checkpoint_step": step,
                 "max_sessions": engine.max_sessions,
                 "compile_count": engine.compile_count,
+                "inference_dtype": engine.inference_dtype,
+                "param_bytes_device": engine.serving_param_bytes,
             }
         ),
         flush=True,
@@ -202,6 +205,13 @@ if __name__ == "__main__":
         "watch_checkpoints_s", 0.0,
         "Poll the workdir checkpoint dir this often and hot-swap newer "
         "steps automatically (0 = off; ignored with --random_init).")
+    flags.DEFINE_enum(
+        "inference_dtype", "f32", ["f32", "bf16", "int8"],
+        "Low-precision serving mode (rt1_tpu/models/quant.py): bf16 casts "
+        "weights+compute once at restore; int8 quantizes the FiLM-"
+        "EfficientNet and transformer matmul weights per-output-channel "
+        "(norms/embeddings/action head stay f32). /reload requantizes "
+        "standby checkpoints — compile_count stays 1.")
     flags.DEFINE_string(
         "embedder", "hash",
         "Instruction embedder spec (hash | ngram | use | table.npz).")

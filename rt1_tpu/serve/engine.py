@@ -71,6 +71,9 @@ class PolicyEngine:
         embed_cache_size: int = 256,
         tokenizer=None,
         plan=None,
+        inference_dtype: str = "f32",
+        prepare_variables: Optional[Callable[[Any], Any]] = None,
+        master_variables=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -80,6 +83,29 @@ class PolicyEngine:
         self._jax = jax
         self._model = model
         self._plan = plan
+        # Low-precision serving (rt1_tpu/models/quant.py): `variables` is
+        # the SERVING tree (already cast/quantized by the restore path);
+        # `prepare_variables` re-derives it from an f32 master checkpoint,
+        # so `swap_variables` can requantize every standby reload; the
+        # master spec (paths/shapes/dtypes of the PRE-quantization tree,
+        # from `master_variables` when given) is what standby buffers are
+        # validated against — a hot-swap always receives masters, never a
+        # pre-quantized tree.
+        self.inference_dtype = inference_dtype
+        self._prepare = prepare_variables
+        spec_src = (
+            master_variables if master_variables is not None else variables
+        )
+        from jax import tree_util as _tree_util
+
+        self._master_spec = [
+            (
+                _tree_util.keystr(path),
+                tuple(leaf.shape),
+                np.dtype(leaf.dtype),
+            )
+            for path, leaf in _tree_util.tree_flatten_with_path(spec_src)[0]
+        ]
         # Device-resident params, passed to the compiled step as an
         # argument (see swap_variables). With a `plan`
         # (rt1_tpu/parallel/plan.py — the same declarative layout train
@@ -284,55 +310,73 @@ class PolicyEngine:
 
     # ------------------------------------------------------------ hot-swap
 
+    @property
+    def serving_param_bytes(self) -> int:
+        """Device-resident serving-tree bytes (int8 kernels + scales count
+        at their quantized size — THE memory win the quant bench records)."""
+        jax = self._jax
+        return int(
+            sum(leaf.nbytes for leaf in jax.tree.leaves(self._variables))
+        )
+
+    @property
+    def master_param_bytes(self) -> int:
+        """Bytes of the f32 master tree this engine restores/reloads from
+        (= the serving bytes of an f32 engine of the same model)."""
+        return int(
+            sum(
+                int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                for _, shape, dtype in self._master_spec
+            )
+        )
+
     def swap_variables(self, new_variables) -> Dict[str, Any]:
         """Zero-downtime checkpoint hot-swap: validate `new_variables` in a
         standby host buffer, move them to the device, then atomically
         repoint the compiled step's param argument between batches.
 
-        The expensive phases (host validation, H2D transfer) run OUTSIDE
-        the engine lock, so in-flight `act_batch` calls are never stalled;
-        only the final pointer swap takes the lock. Because the params are
-        an undonated input of the AOT-compiled executable — identical
-        shapes/dtypes are enforced here — no recompile can occur: the
-        single-compile invariant survives any number of reloads. Raises
-        ValueError (engine untouched, old params keep serving) on a
-        structure/shape/dtype mismatch or a non-finite leaf.
+        The expensive phases (host validation, quantization, H2D transfer)
+        run OUTSIDE the engine lock, so in-flight `act_batch` calls are
+        never stalled; only the final pointer swap takes the lock. Because
+        the params are an undonated input of the AOT-compiled executable —
+        identical shapes/dtypes are enforced here — no recompile can
+        occur: the single-compile invariant survives any number of
+        reloads. Raises ValueError (engine untouched, old params keep
+        serving) on a structure/shape/dtype mismatch or a non-finite leaf.
 
-        Dtype validation is against the MASTER dtype: the serving tree
-        holds the f32 master params (the model's bf16 is a compute dtype —
-        the checkpoint, and therefore this tree, stays float32 under
-        mixed precision), so a standby buffer pre-cast to the compute
-        dtype is rejected rather than silently recompiled or served.
+        Validation is against the MASTER spec, not the serving tree's
+        dtypes: a standby always arrives as the f32 master checkpoint
+        (eval/restore.load_standby_variables contract) — under bf16/int8
+        serving the engine re-runs the same deterministic
+        `prepare_variables` transform quantize-at-restore used, landing on
+        the exact dtypes the step was compiled for. A standby pre-cast to
+        a compute/serving dtype is rejected rather than silently
+        recompiled or served.
         """
         import numpy as np
         from jax import tree_util
 
         jax = self._jax
-        current = [
-            (tree_util.keystr(path), leaf)
-            for path, leaf in tree_util.tree_flatten_with_path(
-                self._variables
-            )[0]
-        ]
         standby = [
             (tree_util.keystr(path), np.asarray(leaf))
             for path, leaf in tree_util.tree_flatten_with_path(
                 new_variables
             )[0]
         ]
-        if [p for p, _ in current] != [p for p, _ in standby]:
+        if [p for p, _ in standby] != [p for p, _, _ in self._master_spec]:
             raise ValueError(
                 "swap_variables: parameter tree structure differs from the "
-                f"serving tree ({len(standby)} vs {len(current)} leaves); "
-                "hot-swap requires a checkpoint of the same model"
+                f"master tree ({len(standby)} vs {len(self._master_spec)} "
+                "leaves); hot-swap requires a checkpoint of the same model"
             )
-        for (path, old), (_, new) in zip(current, standby):
-            if tuple(old.shape) != tuple(new.shape) or old.dtype != new.dtype:
+        for (path, new), (_, shape, dtype) in zip(standby, self._master_spec):
+            if tuple(new.shape) != shape or new.dtype != dtype:
                 raise ValueError(
                     f"swap_variables: leaf {path!r} is "
-                    f"{new.shape}/{new.dtype}, serving "
-                    f"{tuple(old.shape)}/{old.dtype} — a shape or dtype "
-                    "change would force a recompile; rejected"
+                    f"{new.shape}/{new.dtype}, master spec "
+                    f"{shape}/{dtype} — hot-swap expects the f32 master "
+                    "checkpoint (a shape/dtype drift would force a "
+                    "recompile); rejected"
                 )
         bad = [
             path
@@ -346,6 +390,40 @@ class PolicyEngine:
                 f"({len(bad)} leaves) — refusing to serve a corrupt "
                 "checkpoint; old params stay live"
             )
+        # Re-derive the serving tree from the validated masters (cast /
+        # per-channel int8 quantization — deterministic, so the result's
+        # dtypes match the compiled step exactly), still off the lock.
+        if self._prepare is not None:
+            serving = self._prepare(new_variables)
+        else:
+            serving = new_variables
+        serving_flat = [
+            (tree_util.keystr(path), leaf)
+            for path, leaf in tree_util.tree_flatten_with_path(serving)[0]
+        ]
+        current = [
+            (tree_util.keystr(path), leaf)
+            for path, leaf in tree_util.tree_flatten_with_path(
+                self._variables
+            )[0]
+        ]
+        # Final no-recompile gate on the SERVING tree: the prepared tree
+        # must be leaf-for-leaf compatible with what the step compiled
+        # against (catches a quant-rule edit racing a live engine).
+        if [p for p, _ in serving_flat] != [p for p, _ in current]:
+            raise ValueError(
+                "swap_variables: prepared serving tree structure differs "
+                "from the compiled serving tree — quant rules changed "
+                "under a live engine?"
+            )
+        for (path, new), (_, old) in zip(serving_flat, current):
+            if tuple(new.shape) != tuple(old.shape) or new.dtype != old.dtype:
+                raise ValueError(
+                    f"swap_variables: prepared serving leaf {path!r} is "
+                    f"{tuple(new.shape)}/{new.dtype}, compiled "
+                    f"{tuple(old.shape)}/{old.dtype} — rejected to keep "
+                    "the single-compile invariant"
+                )
         # Rebuild on the SERVING treedef (a restored checkpoint may arrive
         # as plain dicts while the engine was built from a FrozenDict —
         # the AOT executable matches treedefs exactly, not just key paths)
@@ -355,7 +433,7 @@ class PolicyEngine:
         # sharded serving too.
         treedef = jax.tree.structure(self._variables)
         device = jax.device_put(
-            jax.tree.unflatten(treedef, [leaf for _, leaf in standby]),
+            jax.tree.unflatten(treedef, [leaf for _, leaf in serving_flat]),
             jax.tree.map(lambda x: x.sharding, self._variables),
         )
         jax.block_until_ready(device)  # pay the H2D cost off the swap
@@ -363,8 +441,11 @@ class PolicyEngine:
             self._variables = device
             self.reloads += 1
         return {
-            "params_swapped": len(standby),
-            "param_bytes": int(sum(leaf.nbytes for _, leaf in standby)),
+            "params_swapped": len(serving_flat),
+            "param_bytes": int(
+                sum(np.asarray(leaf).nbytes for _, leaf in serving_flat)
+            ),
+            "inference_dtype": self.inference_dtype,
         }
 
     # ------------------------------------------------------------ sessions

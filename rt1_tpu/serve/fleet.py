@@ -446,6 +446,37 @@ class FleetSupervisor:
 # -------------------------------------------------------------- entry point
 
 
+# Mirrors models/quant.INFERENCE_DTYPES without importing flax into the
+# supervisor/router process (which stays model-free).
+VALID_REPLICA_DTYPES = ("f32", "bf16", "int8")
+
+
+def replica_dtype_for(args, replica_id: int) -> str:
+    """This replica's inference dtype: the per-replica `--replica_dtypes`
+    list (a mixed-dtype fleet — cheap int8 replicas beside an f32
+    reference) wins over the fleet-wide `--inference_dtype` default.
+
+    Every list entry is validated here — unlike `--inference_dtype` there
+    is no argparse `choices` guard, and an invalid entry would otherwise
+    surface as a replica crash-loop at the CHILD's argparse instead of a
+    message naming the typo.
+    """
+    per_replica = [
+        d.strip()
+        for d in getattr(args, "replica_dtypes", "").split(",")
+        if d.strip()
+    ]
+    for dtype in per_replica:
+        if dtype not in VALID_REPLICA_DTYPES:
+            raise ValueError(
+                f"--replica_dtypes entry {dtype!r} is not one of "
+                f"{VALID_REPLICA_DTYPES}"
+            )
+    if per_replica:
+        return per_replica[replica_id % len(per_replica)]
+    return getattr(args, "inference_dtype", "f32")
+
+
 def replica_argv_builder(args) -> Callable[[int], List[str]]:
     """argv factory for one replica — the stub or the real server."""
     slow_threshold = getattr(args, "slow_threshold_ms", 0.0)
@@ -458,6 +489,7 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
                 "--max_sessions", str(args.max_sessions),
                 "--act_delay_s", str(args.stub_act_delay_s),
                 "--slow_threshold_ms", str(slow_threshold),
+                "--inference_dtype", replica_dtype_for(args, replica_id),
             ]
         return build
 
@@ -470,6 +502,7 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
             "--max_sessions", str(args.max_sessions),
             "--embedder", args.embedder,
             "--slow_threshold_ms", str(slow_threshold),
+            "--inference_dtype", replica_dtype_for(args, replica_id),
         ]
         if args.random_init:
             argv.append("--random_init")
@@ -503,6 +536,16 @@ def main(argv=None) -> int:
     parser.add_argument("--embedder", default="hash")
     parser.add_argument("--stub_act_delay_s", type=float, default=0.0)
     parser.add_argument(
+        "--inference_dtype", default="f32",
+        choices=["f32", "bf16", "int8"],
+        help="Low-precision serving mode forwarded to every replica "
+             "(rt1_tpu/models/quant.py).")
+    parser.add_argument(
+        "--replica_dtypes", default="",
+        help="Comma list assigning a dtype per replica id (cycled), e.g. "
+             "'f32,int8,int8' — a mixed-dtype fleet; overrides "
+             "--inference_dtype.")
+    parser.add_argument(
         "--slow_threshold_ms", type=float, default=0.0,
         help="Replica exemplar-ring threshold, forwarded to every "
              "replica (0 keeps the most recent window of all requests).")
@@ -530,6 +573,10 @@ def main(argv=None) -> int:
 
     if not args.stub and not args.config:
         parser.error("--config is required unless --stub")
+    try:
+        replica_dtype_for(args, 0)  # validates every --replica_dtypes entry
+    except ValueError as exc:
+        parser.error(str(exc))
     if not args.stub and not args.random_init and not args.workdir:
         parser.error("pass --workdir (checkpoint) or --random_init")
 

@@ -129,11 +129,20 @@ class ServeMetrics:
 
     # ------------------------------------------------------------ reporting
 
-    @staticmethod
-    def _coerce_gauge(name: str, value: Any) -> float:
+    # Snapshot keys allowed to carry a string instead of a number — the
+    # engine's dtype mode rides the snapshot verbatim so the Prometheus
+    # renderer can emit it as an info-style labeled family
+    # (`rt1_serve_inference_dtype{dtype="int8"} 1`). Everything else
+    # stays strictly numeric (typo'd gauges must fail loudly, not vanish).
+    TEXT_GAUGES = frozenset({"inference_dtype"})
+
+    @classmethod
+    def _coerce_gauge(cls, name: str, value: Any):
         """Validate a caller-supplied gauge: numeric (including numpy/jax
         scalars) coerces to float; anything else raises, naming the gauge —
         a typo'd gauge must fail the caller, not vanish from /metrics."""
+        if name in cls.TEXT_GAUGES and isinstance(value, str):
+            return value
         if isinstance(value, bool):
             return float(value)
         try:

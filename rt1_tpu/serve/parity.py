@@ -1,0 +1,132 @@
+"""Canned-episode parity gate for low-precision serving engines.
+
+A quantization bug must never ship silently: before a bf16/int8 engine is
+trusted, its action-token stream is compared against the f32 engine's on a
+canned, deterministic episode set. Action tokens are the right unit — they
+are what the robot executes AND what the rolling window stores, so token
+agreement bounds the behavioral divergence of the whole closed loop.
+Tier-1 enforces the gate on the tiny config
+(tests/test_quant.py::test_int8_engine_parity_gate); the serving quant
+bench (`scripts/serve_loadgen.py --quant_ab`) reports the same statistics
+per dtype over HTTP in `BENCH_serve_quant.json`.
+
+Episodes are synthetic (seeded uniform frames + one normal instruction
+embedding per episode) — the gate measures precision loss, not policy
+quality, so any deterministic input stream the two engines both consume is
+valid evidence. Each engine steps its own session; only the observation
+stream is shared, exactly as two replicas of a mixed-dtype fleet would see
+the same traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+# The tier-1-enforced floor for int8-vs-f32 token agreement: below this,
+# quantization noise is flipping decoded actions and the engine must not
+# serve (build_serve_engine callers and tests share one constant).
+PARITY_THRESHOLD = 0.99
+
+
+def canned_episodes(
+    image_shape: Sequence[int],
+    embed_dim: int = 512,
+    episodes: int = 4,
+    steps: int = 8,
+    seed: int = 1234,
+) -> List[List[Dict[str, np.ndarray]]]:
+    """Deterministic synthetic episodes: `episodes` lists of `steps`
+    observations, one fixed instruction embedding per episode (matching a
+    real session's constant instruction across its rolling window)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(episodes):
+        embedding = rng.standard_normal(embed_dim).astype(np.float32)
+        out.append(
+            [
+                {
+                    "image": rng.random(tuple(image_shape)).astype(
+                        np.float32
+                    ),
+                    "natural_language_embedding": embedding,
+                }
+                for _ in range(steps)
+            ]
+        )
+    return out
+
+
+def action_token_agreement(
+    engine_ref: Any,
+    engine_test: Any,
+    episodes: Sequence[Sequence[Dict[str, np.ndarray]]],
+) -> Dict[str, Any]:
+    """Step both engines through the same observation streams and compare
+    action tokens elementwise.
+
+    Returns agreement statistics (``agreement`` in [0, 1], plus the max
+    absolute de-normalized action delta — the physical-units view of the
+    same divergence). Each engine advances its own rolling state from its
+    own weights; tokens are compared per step, so a divergence that
+    compounds through the window is charged to every later step it
+    corrupts, not amortized away.
+    """
+    total = 0
+    agree = 0
+    steps = 0
+    max_action_diff = 0.0
+    for index, episode in enumerate(episodes):
+        sid = f"parity-{index}"
+        engine_ref.reset(sid)
+        engine_test.reset(sid)
+        for obs in episode:
+            ref = engine_ref.act(sid, dict(obs))
+            test = engine_test.act(sid, dict(obs))
+            ref_tokens = np.asarray(ref["action_tokens"])
+            test_tokens = np.asarray(test["action_tokens"])
+            total += int(ref_tokens.size)
+            agree += int((ref_tokens == test_tokens).sum())
+            max_action_diff = max(
+                max_action_diff,
+                float(
+                    np.max(np.abs(ref["action"] - test["action"]))
+                ),
+            )
+            steps += 1
+        engine_ref.release(sid)
+        engine_test.release(sid)
+    return {
+        "episodes": len(episodes),
+        "steps": steps,
+        "tokens_total": total,
+        "tokens_agree": agree,
+        "agreement": (agree / total) if total else 1.0,
+        "max_abs_action_diff": max_action_diff,
+    }
+
+
+def check_parity(
+    engine_ref: Any,
+    engine_test: Any,
+    image_shape: Sequence[int],
+    threshold: float = PARITY_THRESHOLD,
+    **episode_kwargs: Any,
+) -> Dict[str, Any]:
+    """Run the gate; raise ValueError (with the stats in the message) when
+    agreement lands below `threshold`. Returns the stats dict on pass."""
+    stats = action_token_agreement(
+        engine_ref, engine_test, canned_episodes(image_shape, **episode_kwargs)
+    )
+    stats["threshold"] = threshold
+    stats["passed"] = stats["agreement"] >= threshold
+    if not stats["passed"]:
+        raise ValueError(
+            f"low-precision parity gate FAILED: action-token agreement "
+            f"{stats['agreement']:.4f} < {threshold} over "
+            f"{stats['tokens_total']} tokens "
+            f"(max action delta {stats['max_abs_action_diff']:.5f}) — "
+            "refusing to trust this engine"
+        )
+    return stats

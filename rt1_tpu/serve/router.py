@@ -562,6 +562,8 @@ class Router:
                             "requests_total",
                             "active_sessions",
                             "uptime_s",
+                            "inference_dtype",
+                            "param_bytes_device",
                         )
                     }
             replicas.append(entry)

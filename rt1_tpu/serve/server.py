@@ -340,6 +340,9 @@ class ServeApp:
             "active_sessions": self.engine.active_sessions,
             "compile_count": self.engine.compile_count,
             "reloads": self.engine.reloads,
+            "inference_dtype": getattr(
+                self.engine, "inference_dtype", "f32"
+            ),
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
@@ -371,6 +374,17 @@ class ServeApp:
             "ready": int(self.ready),
             "reloading": int(self.reloading),
             "replica_id": self.replica_id,
+            # Low-precision serving mode + the param-byte evidence behind
+            # its memory claim (docs/serving.md "Low-precision serving").
+            "inference_dtype": getattr(
+                self.engine, "inference_dtype", "f32"
+            ),
+            "param_bytes_device": getattr(
+                self.engine, "serving_param_bytes", 0
+            ),
+            "param_bytes_master": getattr(
+                self.engine, "master_param_bytes", 0
+            ),
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:

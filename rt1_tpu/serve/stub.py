@@ -64,9 +64,13 @@ class StubReplicaApp:
         act_delay_s: float = 0.0,
         reload_delay_s: float = 0.05,
         slow_threshold_ms: float = 0.0,
+        inference_dtype: str = "f32",
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
+        # Advertised low-precision mode (the real replica's engine gauge);
+        # lets tier-1 prove mixed-dtype fleet aggregation with no jax.
+        self.inference_dtype = inference_dtype
         self.act_delay_s = act_delay_s
         self.reload_delay_s = reload_delay_s
         self.metrics = ServeMetrics()
@@ -208,6 +212,7 @@ class StubReplicaApp:
             "active_sessions": active,
             "compile_count": 1,  # the contract field; nothing compiles here
             "reloads": self.reloads,
+            "inference_dtype": self.inference_dtype,
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
@@ -230,6 +235,11 @@ class StubReplicaApp:
             "reloading": int(self.reloading),
             "replica_id": self.replica_id,
             "slow_exemplars": len(self.exemplars),
+            "inference_dtype": self.inference_dtype,
+            # Deterministic stand-in bytes: a mixed-dtype fleet test can
+            # assert the per-replica gauge plumbing end to end.
+            "param_bytes_device": 1000 + self.replica_id,
+            "param_bytes_master": 4000,
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -339,6 +349,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--slow_threshold_ms", type=float, default=0.0,
         help="Exemplar-ring threshold (0 keeps the most recent window).")
+    parser.add_argument(
+        "--inference_dtype", default="f32",
+        choices=["f32", "bf16", "int8"],
+        help="Advertised low-precision mode (protocol double for the "
+             "real replica's --inference_dtype).")
     args = parser.parse_args(argv)
 
     # Bounded in-process trace ring so GET /trace (and the fleet tests'
@@ -350,6 +365,7 @@ def main(argv=None) -> int:
         act_delay_s=args.act_delay_s,
         reload_delay_s=args.reload_delay_s,
         slow_threshold_ms=args.slow_threshold_ms,
+        inference_dtype=args.inference_dtype,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
     if args.startup_delay_s:
@@ -373,6 +389,7 @@ def main(argv=None) -> int:
                 "checkpoint_step": -1,
                 "max_sessions": args.max_sessions,
                 "compile_count": 1,
+                "inference_dtype": args.inference_dtype,
             }
         ),
         flush=True,

@@ -57,6 +57,9 @@ def build_model(model_config, mesh=None):
         tokenizer_def = TinyImageTokenizer(
             num_tokens=model_config.num_image_tokens,
             emb=model_config.token_embedding_size,
+            dtype=jnp.bfloat16
+            if model_config.dtype == "bfloat16"
+            else jnp.float32,
         )
     elif model_config.image_tokenizer == "efficientnet_small":
         # Same FiLM-EfficientNet + TokenLearner family at ~0.35/0.35 scaling:

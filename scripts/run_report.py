@@ -94,6 +94,13 @@ def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
                 pass
             else:
                 break
+    path = os.path.join(workdir, "BENCH_serve_quant.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                out["quant_bench"] = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            pass
     path = os.path.join(workdir, "slow_requests.jsonl")
     if os.path.exists(path):
         try:
@@ -302,8 +309,9 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     lines = ["## Serve post-mortem (SLO ledger)", ""]
     slo = serve.get("slo") if serve else None
     bench = serve.get("bench") if serve else None
+    quant = serve.get("quant_bench") if serve else None
     exemplars = serve.get("exemplars") if serve else None
-    if slo is None and bench is None and exemplars is None:
+    if slo is None and bench is None and exemplars is None and quant is None:
         lines.append(
             "No serving artifacts (slo_summary.json / BENCH_serve_*.json / "
             "slow_requests.jsonl) in the workdir."
@@ -374,6 +382,41 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
                 f"compile counts {bench.get('replica_compile_counts')}, "
                 f"{bench.get('replicas_ready_at_end', '?')} ready at end."
             )
+    if quant is not None:
+        # The low-precision serving story next to the SLO verdict: a
+        # mixed-dtype fleet's latency/parity/bytes read out of one table
+        # (BENCH_serve_quant.json, scripts/serve_loadgen.py --quant_ab).
+        lines.append("")
+        lines.append(
+            f"Low-precision serving (BENCH_serve_quant.json): int8 "
+            f"param-byte reduction {quant.get('value', 0)}x "
+            f"({quant.get('unit', 'x')} headline, flagship tree)."
+        )
+        lines.append(
+            f"{'dtype':<8}{'p50 ms':>10}{'p99 ms':>10}{'req/s':>10}"
+            f"{'device MB':>12}{'parity':>9}{'failed':>8}"
+        )
+        for dtype, row in (quant.get("per_dtype") or {}).items():
+            parity = (row.get("parity") or {}).get("agreement")
+            dev = row.get("param_bytes_device")
+            lines.append(
+                f"{dtype:<8}"
+                f"{row.get('latency_p50_ms', 0):>10.2f}"
+                f"{row.get('latency_p99_ms', 0):>10.2f}"
+                f"{row.get('req_per_sec', 0):>10.2f}"
+                + (
+                    f"{dev / 1e6:>12.3f}" if dev is not None
+                    else f"{'-':>12}"
+                )
+                + (
+                    f"{parity * 100:>8.1f}%" if parity is not None
+                    else f"{'-':>9}"
+                )
+                + f"{row.get('requests_failed', 0):>8}"
+            )
+        note = quant.get("honesty_note")
+        if note:
+            lines.append(f"Note: {note}")
     records = (exemplars or {}).get("records", [])
     if exemplars is not None:
         header = exemplars.get("header", {})

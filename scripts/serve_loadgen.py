@@ -62,6 +62,7 @@ import argparse
 import base64
 import json
 import os
+import select
 import signal
 import subprocess
 import sys
@@ -418,6 +419,211 @@ def run_overhead_ab(args) -> dict:
     }
 
 
+# ------------------------------------------------------------------ quant
+
+
+def _spawn_server(args, inference_dtype: str):
+    """Boot one `python -m rt1_tpu.serve` replica at `inference_dtype`;
+    returns (proc, url, ready_line) once the ready-line lands."""
+    cmd = [
+        sys.executable, "-m", "rt1_tpu.serve",
+        "--config", args.config,
+        "--random_init",
+        "--port", "0",
+        "--max_sessions", str(args.max_sessions),
+        "--inference_dtype", inference_dtype,
+    ]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    deadline = time.time() + args.fleet_warmup_timeout_s
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve --inference_dtype {inference_dtype} exited "
+                f"rc={proc.returncode} before ready"
+            )
+        if time.time() > deadline:
+            proc.kill()
+            raise TimeoutError(f"{inference_dtype} server not ready in time")
+        # select-gate the pipe read: a live replica that is still
+        # compiling writes nothing, and a bare readline() would block past
+        # the deadline forever.
+        readable, _, _ = select.select([proc.stdout], [], [], 1.0)
+        if not readable:
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.1)
+            continue
+        try:
+            ready = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if ready.get("status") == "serving":
+            return proc, f"http://127.0.0.1:{ready['port']}", ready
+
+
+def _parity_probe(url: str, image_shape, embed_dim: int, steps: int,
+                  seed: int, timeout: float):
+    """Drive one session through `steps` DETERMINISTIC frames (seeded rng,
+    fixed embedding) and return the per-step action-token lists — the
+    HTTP-level twin of rt1_tpu/serve/parity.py. Identical streams against
+    two servers of different dtype make their token streams comparable."""
+    rng = np.random.default_rng(seed)
+    embedding = rng.standard_normal(embed_dim).astype(np.float32)
+    sid = "quant-parity"
+    status, _ = _post(url + "/reset", {"session_id": sid}, timeout)
+    if status != 200:
+        raise RuntimeError(f"parity probe /reset failed: {status}")
+    tokens = []
+    for _ in range(steps):
+        frame = rng.integers(0, 256, size=image_shape, dtype=np.uint8)
+        status, body = _post(
+            url + "/act",
+            {
+                "session_id": sid,
+                "image_b64": base64.b64encode(frame.tobytes()).decode(
+                    "ascii"
+                ),
+                "embedding": [float(x) for x in embedding],
+            },
+            timeout,
+        )
+        if status != 200:
+            raise RuntimeError(f"parity probe /act failed: {status} {body}")
+        tokens.append(list(body["action_tokens"]))
+    _post(url + "/release", {"session_id": sid}, timeout)
+    return tokens
+
+
+def _load_config_module(path: str):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("quant_bench_config", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module.get_config()
+
+
+def run_quant_ab(args) -> dict:
+    """Per-dtype serving A/B: boot one random-init replica per
+    `--quant_ab` dtype (same deterministic PRNGKey(0) weights), measure
+    latency/req/s under identical load, probe action-token parity against
+    the f32 side over HTTP, and record host+device param bytes.
+
+    Two byte accountings ride the record: the MEASURED serving tree of
+    the config under test (tiny in tier-1 lineage — where the 256-entry
+    position table dominates and caps the reduction) and the flagship
+    projection from abstract shapes (`--byte_report_config`,
+    rt1_tpu/models/quant.py quant_byte_report) — the tree a production
+    fleet actually holds. Honesty note: XLA:CPU has no native int8 matmul,
+    so CPU latency measures the dequant-added path; bytes moved is the
+    measured win, TPU latency is the projection (same methodology as
+    BENCH_packed_e2e.json).
+    """
+    dtypes = [d.strip() for d in args.quant_ab.split(",") if d.strip()]
+    if "f32" not in dtypes:
+        dtypes = ["f32"] + dtypes  # parity needs the reference side
+    per_dtype: dict = {}
+    parity_tokens: dict = {}
+    for dtype in dtypes:
+        proc, url, ready = _spawn_server(args, dtype)
+        try:
+            health = _get(url + "/healthz", args.timeout)
+            image_shape = tuple(health["image_shape"])
+            parity_tokens[dtype] = _parity_probe(
+                url, image_shape, health.get("embed_dim", 512),
+                args.parity_steps, args.seed + 7919, args.timeout,
+            )
+            run = run_loadgen(
+                url,
+                sessions=args.sessions,
+                steps=args.steps,
+                duration_s=args.duration,
+                think_time_s=args.think_time,
+                timeout=args.timeout,
+                max_retries=args.max_retries,
+                seed=args.seed,
+                slo_objectives=_objectives(args),
+            )
+            metrics = _get(url + "/metrics", args.timeout)
+            per_dtype[dtype] = {
+                "req_per_sec": run["value"],
+                "latency_p50_ms": run["latency_p50_ms"],
+                "latency_p99_ms": run["latency_p99_ms"],
+                "requests_ok": run["requests_ok"],
+                "requests_failed": run["requests_failed"],
+                "compile_count": metrics.get("compile_count"),
+                "param_bytes_device": metrics.get("param_bytes_device"),
+                "param_bytes_master": metrics.get("param_bytes_master"),
+            }
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    reference = np.asarray(parity_tokens["f32"])
+    for dtype in dtypes:
+        tokens = np.asarray(parity_tokens[dtype])
+        total = int(reference.size)
+        agree = int((tokens == reference).sum())
+        per_dtype[dtype]["parity"] = {
+            "tokens_total": total,
+            "tokens_agree": agree,
+            "agreement": round(agree / total, 4) if total else 1.0,
+        }
+    f32_bytes = per_dtype["f32"]["param_bytes_device"]
+    for dtype in dtypes:
+        dev = per_dtype[dtype]["param_bytes_device"]
+        per_dtype[dtype]["byte_reduction_vs_f32"] = (
+            round(f32_bytes / dev, 3) if dev else 0.0
+        )
+
+    flagship_report = None
+    if args.byte_report_config:
+        try:
+            from rt1_tpu.models.quant import quant_byte_report
+
+            flagship_report = quant_byte_report(
+                _load_config_module(args.byte_report_config)
+            )
+        except Exception as exc:  # noqa: BLE001 - report, don't fail the run
+            flagship_report = {"error": str(exc)}
+
+    headline = (flagship_report or {}).get(
+        "int8_reduction",
+        per_dtype.get("int8", {}).get("byte_reduction_vs_f32", 0.0),
+    )
+    return {
+        "metric": "serve_param_bytes_reduction_int8",
+        "value": headline,
+        "unit": "x",
+        "dtypes": dtypes,
+        "per_dtype": per_dtype,
+        "requests_failed": sum(
+            row["requests_failed"] for row in per_dtype.values()
+        ),
+        "parity_steps": args.parity_steps,
+        "sessions": args.sessions,
+        "steps_per_session": args.steps if args.duration <= 0 else None,
+        "duration_s": args.duration if args.duration > 0 else None,
+        "flagship_byte_report": flagship_report,
+        "timing_methodology": (
+            "one random-init replica per dtype (identical PRNGKey(0) "
+            "weights), identical load per side; parity = HTTP action-token "
+            "agreement vs the f32 side on one deterministic frame stream"
+        ),
+        "honesty_note": (
+            "XLA:CPU has no native int8 matmul — the int8 side pays a "
+            "dequant per weight use on this host, so CPU req/s is NOT the "
+            "int8 speed story; the measured win is param bytes resident/"
+            "moved (device + master columns, flagship_byte_report for the "
+            "production tree), and TPU latency is the projection (native "
+            "bf16 MXU + int8-fused dequant), as in BENCH_packed_e2e.json"
+        ),
+    }
+
+
 # ------------------------------------------------------------------ fleet
 
 
@@ -441,6 +647,10 @@ def run_fleet_chaos(args) -> dict:
         cmd += ["--faults", args.faults]
     if args.log_dir:
         cmd += ["--log_dir", args.log_dir]
+    if args.inference_dtype != "f32":
+        cmd += ["--inference_dtype", args.inference_dtype]
+    if args.replica_dtypes:
+        cmd += ["--replica_dtypes", args.replica_dtypes]
     if args.stub:
         cmd += ["--stub"]
     else:
@@ -633,9 +843,56 @@ def main() -> int:
     parser.add_argument("--fleet_warmup_timeout_s", type=float, default=600.0)
     parser.add_argument("--log_dir", default="",
                         help="[fleet] per-replica stderr log dir.")
+    parser.add_argument(
+        "--inference_dtype", default="f32",
+        choices=["f32", "bf16", "int8"],
+        help="[fleet] low-precision serving mode forwarded to every "
+             "replica (rt1_tpu/models/quant.py).")
+    parser.add_argument(
+        "--replica_dtypes", default="",
+        help="[fleet] per-replica dtype list (cycled), e.g. 'f32,int8' — "
+             "a mixed-dtype fleet; overrides --inference_dtype.")
+    parser.add_argument(
+        "--quant_ab", default="",
+        help="Per-dtype serving A/B: comma dtypes (e.g. 'f32,bf16,int8'); "
+             "boots one random-init replica per dtype with --config, "
+             "measures latency/req-s/param-bytes + HTTP token parity vs "
+             "f32, and writes the BENCH_serve_quant.json record "
+             "(--output).")
+    parser.add_argument(
+        "--parity_steps", type=int, default=24,
+        help="[quant_ab] deterministic frames in the parity probe.")
+    parser.add_argument(
+        "--byte_report_config",
+        default=os.path.join(
+            _REPO, "rt1_tpu", "train", "configs", "language_table.py"
+        ),
+        help="[quant_ab] config whose abstract-shape per-dtype byte "
+             "report rides the record ('' disables; default: the "
+             "flagship config — the production serving tree).")
     args = parser.parse_args()
 
-    if args.fleet > 0:
+    if args.replica_dtypes or args.quant_ab:
+        # Same guard the fleet entry point applies: fail at THIS parser
+        # with the typo named, not as a replica crash-loop downstream.
+        from rt1_tpu.serve.fleet import VALID_REPLICA_DTYPES, replica_dtype_for
+
+        try:
+            replica_dtype_for(args, 0)
+        except ValueError as exc:
+            parser.error(str(exc))
+        for dtype in args.quant_ab.split(","):
+            if dtype.strip() and dtype.strip() not in VALID_REPLICA_DTYPES:
+                parser.error(
+                    f"--quant_ab entry {dtype.strip()!r} is not one of "
+                    f"{VALID_REPLICA_DTYPES}"
+                )
+
+    if args.quant_ab:
+        if not args.config:
+            parser.error("--quant_ab needs --config")
+        result = run_quant_ab(args)
+    elif args.fleet > 0:
         if not args.stub and not args.config:
             parser.error("--fleet needs --config (or --stub)")
         result = run_fleet_chaos(args)

@@ -141,6 +141,55 @@ from rt1_tpu.eval.restore import serving_plan
 
 assert serving_plan({"parallel": {}}).mesh.devices.size == 1
 
+# ISSUE 9 low-precision serving: the quant mechanics, the parity gate,
+# and the plan's quant rules all run inside serve processes — importable
+# and functional under the blocker (flax/jax allowed; the training stack
+# is not).
+from rt1_tpu.models.quant import (
+    dequantize,
+    quantize_per_channel,
+    serving_preparer,
+    tree_bytes,
+)
+
+q, s = quantize_per_channel(_np.ones((4, 3), _np.float32))
+assert q.dtype == _np.int8 and s.shape == (3,)
+assert (dequantize(q, s) == 1.0).all()
+assert serving_preparer("f32") is None
+assert serving_preparer("int8") is not None
+assert tree_bytes({"w": _np.zeros((2, 2), _np.float32)}) == 16
+
+from rt1_tpu.parallel.plan import (
+    QUANT_F32,
+    QUANT_INT8,
+    quant_group_for_path,
+    rt1_quant_rules,
+)
+
+assert rt1_quant_rules()
+assert quant_group_for_path(
+    "params/transformer/layer_0/attn/query/kernel") == QUANT_INT8
+assert quant_group_for_path(
+    "params/transformer/output_tokens/kernel") == QUANT_F32
+
+from rt1_tpu.serve.parity import PARITY_THRESHOLD, canned_episodes
+
+assert PARITY_THRESHOLD >= 0.99
+assert len(canned_episodes((2, 2, 3), episodes=1, steps=2)[0]) == 2
+
+# A mixed-dtype stub advertises its mode; the fleet renderer turns it
+# into the labeled info family the scrape contract names.
+assert StubReplicaApp(
+    replica_id=1, inference_dtype="int8").healthz()["inference_dtype"] == "int8"
+dtype_text = render_fleet_snapshot(
+    {}, {0: {"inference_dtype": "int8", "param_bytes_device": 7.0}})
+assert (
+    'rt1_serve_replica_inference_dtype{replica_id="0",dtype="int8"} 1'
+    in dtype_text
+)
+assert 'rt1_serve_replica_param_bytes_device{replica_id="0"} 7' in dtype_text
+assert "rt1_serve_replica_inference_dtype" in fleet_metric_names()
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

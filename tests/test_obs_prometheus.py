@@ -267,10 +267,82 @@ def test_fleet_metric_names_all_renderable():
     full = {
         key: 1.0 for key in prom._FLEET_REPLICA_FIELDS
     }
+    # The dtype family is info-style: it renders from the string gauge,
+    # not a numeric field.
+    full["inference_dtype"] = "int8"
     text = prom.render_fleet_snapshot({}, {0: full})
     types, _ = parse_exposition(text)
     for name in names:
         assert name in types, f"{name} missing from a full snapshot render"
+
+
+def test_inference_dtype_info_family_and_param_bytes_gauges():
+    """Low-precision serving naming contract (ISSUE 9): the engine's dtype
+    mode renders as an info-style labeled family
+    (`rt1_serve_inference_dtype{dtype="int8"} 1`) and the param-byte
+    evidence behind its memory claim renders as plain gauges — all through
+    the one snapshot→text path the replica /metrics takes."""
+    snap = ServeMetrics().snapshot(
+        inference_dtype="int8",
+        param_bytes_device=29208,
+        param_bytes_master=50528,
+    )
+    assert snap["inference_dtype"] == "int8"  # TEXT_GAUGES passthrough
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_inference_dtype"] == "gauge"
+    dtype_samples = [
+        (labels, float(v))
+        for n, labels, v in samples
+        if n == "rt1_serve_inference_dtype"
+    ]
+    assert dtype_samples == [({"dtype": "int8"}, 1.0)]
+    by_name = {n: float(v) for n, labels, v in samples if not labels}
+    assert by_name["rt1_serve_param_bytes_device"] == 29208.0
+    assert by_name["rt1_serve_param_bytes_master"] == 50528.0
+
+
+def test_fleet_mixed_dtype_labeled_families():
+    """A mixed-dtype fleet's aggregated exposition: one
+    `rt1_serve_replica_inference_dtype{replica_id,dtype}` info family plus
+    per-replica param-byte gauges, so a per-dtype latency dashboard needs
+    no enum mapping (ISSUE 9 mixed-dtype replicas satellite)."""
+    replicas = {
+        0: {
+            "compile_count": 1,
+            "inference_dtype": "f32",
+            "param_bytes_device": 50528.0,
+            "param_bytes_master": 50528.0,
+        },
+        1: {
+            "compile_count": 1,
+            "inference_dtype": "int8",
+            "param_bytes_device": 29208.0,
+            "param_bytes_master": 50528.0,
+        },
+        2: None,  # dead probe: no dtype claim, only replica_up 0
+    }
+    text = prom.render_fleet_snapshot({}, replicas)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_replica_inference_dtype"] == "gauge"
+    dtypes = {
+        labels["replica_id"]: labels["dtype"]
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_inference_dtype"
+    }
+    assert dtypes == {"0": "f32", "1": "int8"}
+    device_bytes = {
+        labels["replica_id"]: float(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_param_bytes_device"
+    }
+    assert device_bytes == {"0": 50528.0, "1": 29208.0}
+    assert types["rt1_serve_replica_param_bytes_master"] == "gauge"
+    # The scrape-config contract names every new family.
+    names = prom.fleet_metric_names()
+    assert "rt1_serve_replica_inference_dtype" in names
+    assert "rt1_serve_replica_param_bytes_device" in names
+    assert "rt1_serve_replica_param_bytes_master" in names
 
 
 def test_family_label_escaping():

@@ -256,6 +256,48 @@ def test_serve_postmortem_section(tmp_path):
     assert slowest.rstrip().endswith("restarted")
 
 
+def test_serve_quant_bench_renders_dtype_table(tmp_path):
+    """ISSUE 9 satellite: BENCH_serve_quant.json folds into the serve
+    post-mortem as a per-dtype latency/parity/bytes table next to the SLO
+    verdict, honesty note included."""
+    wd = _canned_serve_workdir(tmp_path)
+    quant = {
+        "metric": "serve_param_bytes_reduction_int8",
+        "value": 3.71,
+        "unit": "x",
+        "per_dtype": {
+            "f32": {
+                "req_per_sec": 100.2, "latency_p50_ms": 66.1,
+                "latency_p99_ms": 219.9, "requests_failed": 0,
+                "param_bytes_device": 50528,
+                "parity": {"agreement": 1.0},
+            },
+            "int8": {
+                "req_per_sec": 150.6, "latency_p50_ms": 48.1,
+                "latency_p99_ms": 92.3, "requests_failed": 0,
+                "param_bytes_device": 29208,
+                "parity": {"agreement": 0.997},
+            },
+        },
+        "honesty_note": "XLA:CPU lacks native int8 matmul",
+    }
+    with open(os.path.join(wd, "BENCH_serve_quant.json"), "w") as f:
+        json.dump(quant, f)
+    serve = run_report.load_serve(wd)
+    assert serve["quant_bench"]["value"] == 3.71
+    report = run_report.render_report(wd, None, None, None, serve=serve)
+    assert "int8 param-byte reduction 3.71x" in report
+    lines = report.splitlines()
+    f32_row = next(ln for ln in lines if ln.startswith("f32 "))
+    int8_row = next(ln for ln in lines if ln.startswith("int8 "))
+    assert "66.10" in f32_row and "100.0%" in f32_row
+    assert "48.10" in int8_row and "99.7%" in int8_row
+    assert "0.029" in int8_row  # device MB column
+    assert "Note: XLA:CPU lacks native int8 matmul" in report
+    # The SLO verdict still leads the section — the dtype table rides it.
+    assert report.index("SLO met.") < report.index("int8 param-byte")
+
+
 def test_serve_section_absent_for_training_only_run(tmp_path):
     """A pure training workdir renders NO serve section — the golden
     training report stays byte-stable."""

@@ -25,11 +25,19 @@ from rt1_tpu.serve.router import DEAD, READY, Router, make_router_server
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# The module fleet is mixed-dtype (replica 0 f32, replica 1 int8 — the
+# ISSUE 9 cheap-replicas-beside-a-reference shape) so every aggregation
+# test below doubles as proof the dtype gauge plumbing survives the
+# router fan-out.
+_STUB_DTYPES = ("f32", "int8")
+
+
 def _stub_argv(replica_id: int):
     return [
         sys.executable, "-m", "rt1_tpu.serve.stub",
         "--port", "0",
         "--replica_id", str(replica_id),
+        "--inference_dtype", _STUB_DTYPES[replica_id % len(_STUB_DTYPES)],
     ]
 
 
@@ -241,6 +249,70 @@ def test_fleet_metrics_aggregation_json_and_prometheus(fleet):
     # SLO families render under the serve prefix.
     assert "rt1_serve_slo_availability" in text
     assert "rt1_serve_slo_error_budget_burn" in text
+
+
+def test_mixed_dtype_fleet_advertises_per_replica_dtype(fleet):
+    """ISSUE 9 mixed-dtype fleet plumbing: one replica serving int8 beside
+    an f32 reference is visible end to end — replica ready-line and
+    /healthz, the router's /fleet/status curated metrics, the aggregated
+    JSON snapshots, and the Prometheus info-style labeled family — with
+    the param-bytes evidence gauges riding along."""
+    router, _, url = fleet
+    status, fs = _get(url + "/fleet/status")
+    assert status == 200
+    by_id = {r["id"]: r for r in fs["replicas"]}
+    assert by_id[0]["metrics"]["inference_dtype"] == "f32"
+    assert by_id[1]["metrics"]["inference_dtype"] == "int8"
+    assert all(
+        r["metrics"]["param_bytes_device"] > 0 for r in fs["replicas"]
+    )
+
+    status, body = _get(url + "/metrics")
+    assert status == 200
+    assert body["replicas"]["0"]["inference_dtype"] == "f32"
+    assert body["replicas"]["1"]["inference_dtype"] == "int8"
+    for rid, snap in body["replicas"].items():
+        # The stub's deterministic stand-in bytes prove the gauge path.
+        assert snap["param_bytes_device"] == 1000 + int(rid)
+        assert snap["param_bytes_master"] == 4000
+
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        text = resp.read().decode("utf-8")
+    assert (
+        'rt1_serve_replica_inference_dtype{replica_id="0",dtype="f32"} 1'
+        in text
+    )
+    assert (
+        'rt1_serve_replica_inference_dtype{replica_id="1",dtype="int8"} 1'
+        in text
+    )
+    assert 'rt1_serve_replica_param_bytes_device{replica_id="1"} 1001' in text
+    assert 'rt1_serve_replica_param_bytes_master{replica_id="0"} 4000' in text
+
+
+def test_replica_dtype_assignment_for_fleet_argv():
+    """`--replica_dtypes` cycles per replica id and beats the fleet-wide
+    `--inference_dtype`; both land in the spawned replica argv."""
+    import argparse
+
+    from rt1_tpu.serve.fleet import replica_argv_builder, replica_dtype_for
+
+    args = argparse.Namespace(
+        stub=True, max_sessions=8, stub_act_delay_s=0.0,
+        slow_threshold_ms=0.0, inference_dtype="bf16",
+        replica_dtypes="f32,int8",
+    )
+    assert replica_dtype_for(args, 0) == "f32"
+    assert replica_dtype_for(args, 1) == "int8"
+    assert replica_dtype_for(args, 2) == "f32"  # cycled
+    argv = replica_argv_builder(args)(1)
+    assert argv[argv.index("--inference_dtype") + 1] == "int8"
+    # Without the per-replica list, the fleet-wide mode applies everywhere.
+    args.replica_dtypes = ""
+    assert replica_dtype_for(args, 5) == "bf16"
 
 
 def test_slo_endpoint_and_fleet_slow_requests(fleet):
