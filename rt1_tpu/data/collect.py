@@ -35,6 +35,7 @@ def collect_episode(
     image_hw=None,
     exec_noise_std=0.0,
     noise_rng=None,
+    task=None,
 ):
     """One oracle rollout -> episode dict, or None if init/solve failed.
 
@@ -96,6 +97,12 @@ def collect_episode(
     from rt1_tpu.data.episodes import encode_instruction_text
 
     episode["instruction_text"] = encode_instruction_text(env.instruction_str)
+    if task:
+        # The per-episode task id (normally the reward family). Carried
+        # through the pack manifest (`data/pack.py`) and exposed by
+        # `PackedEpisodeCache.episode_task` — the hook task-mixture
+        # sampling weights against.
+        episode["task"] = encode_instruction_text(task)
     return episode
 
 
@@ -141,6 +148,7 @@ def collect_dataset(
         ep = collect_episode(
             env, oracle, embed_fn, max_steps=max_steps, image_hw=image_hw,
             exec_noise_std=exec_noise_std, noise_rng=noise_rng,
+            task=reward_name,
         )
         if ep is None:
             continue
@@ -263,6 +271,7 @@ def _collect_shard(shard_dir, count, seed, kwargs):
             image_hw=kwargs.get("image_hw"),
             exec_noise_std=kwargs.get("exec_noise_std", 0.0),
             noise_rng=noise_rng,
+            task=kwargs.get("reward_name", "block2block"),
         )
         if ep is None:
             continue

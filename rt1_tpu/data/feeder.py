@@ -11,23 +11,33 @@ loop, via `data.pipeline.device_feeder`) pops ready uint8 batches and
 spends its host slice only on `jax.device_put`.
 
 Determinism: the batch schedule and every crop draw are functions of
-(seed, epoch, batch-index) only — never of thread count or timing — so two
-feeders with the same seed yield identical batch streams, and a 1-thread
-feeder reproduces an 8-thread one bit-for-bit (pinned in
+(seed, epoch, batch-in-epoch) only — never of thread count or timing — so
+two feeders with the same seed yield identical batch streams, and a
+1-thread feeder reproduces an 8-thread one bit-for-bit (pinned in
 tests/test_feeder.py).
+
+Flywheel (`refresh_at_epoch=True`): at every epoch boundary the feeder asks
+the cache to re-read its manifest and open any newly appended shards
+(`PackedEpisodeCache.refresh`), then draws that epoch's shuffle over the
+grown window set. The epoch stream stays a pure function of
+(seed, epoch, corpus-at-epoch-start): because the crop rng is keyed on
+(epoch, batch-in-epoch) — not on the flat ticket — a feeder that picked a
+shard up mid-run emits byte-identical epochs to one constructed after the
+append (pinned in tests/test_flywheel.py). A mid-epoch append never
+perturbs the epoch in flight.
 
 Lifecycle: `close()` (or the context manager / garbage collection) stops
 the workers promptly even when queues are full; a finite `num_epochs`
-stream raises StopIteration after exactly
-floor(windows / batch) * num_epochs batches.
+stream raises StopIteration after exactly the per-epoch batch counts sum.
 """
 
 from __future__ import annotations
 
+import bisect
 import queue
 import threading
 import time
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -69,6 +79,7 @@ class SampleAheadFeeder:
         process_count: int = 1,
         start: bool = True,
         stall_timeout_s: Optional[float] = None,
+        refresh_at_epoch: bool = False,
     ):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
@@ -87,28 +98,35 @@ class SampleAheadFeeder:
         self.depth = max(1, depth)
         self.process_index = process_index
         self.process_count = process_count
+        self.refresh_at_epoch = refresh_at_epoch
 
-        n_windows = len(cache.index) // process_count + (
-            1 if process_index < len(cache.index) % process_count else 0
-        )
-        self.batches_per_epoch = n_windows // batch_size
+        # Per-epoch corpus snapshots: each entry pins the window count and
+        # shuffle order one epoch's batches are drawn from, so a flywheel
+        # append only ever changes epochs whose order has not been drawn
+        # yet. `_firsts[e]` = the first global ticket of epoch e (epochs
+        # have different batch counts once the corpus grows).
+        self._order_lock = threading.Lock()
+        self._epochs: List[Dict] = []
+        self._firsts: List[int] = []
+        self._materialize_next_epoch_locked_unsafe()
+        self.batches_per_epoch = self._epochs[0]["batches"]
         if self.batches_per_epoch == 0:
             raise ValueError(
                 f"batch_size {batch_size} exceeds this process's "
-                f"{n_windows} windows"
+                f"{len(self._epochs[0]['order'])} windows"
             )
+        # Static corpora keep the exact pre-flywheel exhaustion arithmetic;
+        # a refreshing feeder's end is located per-epoch (counts can grow).
         self.total_batches = (
-            None
-            if num_epochs is None
-            else self.batches_per_epoch * num_epochs
+            self.batches_per_epoch * num_epochs
+            if num_epochs is not None and not refresh_at_epoch
+            else None
         )
 
         meta0 = cache.meta(0)
         self._embed_dim = int(meta0["instruction"].shape[1])
         self._action_dim = int(meta0["action"].shape[1])
 
-        self._order_lock = threading.Lock()
-        self._order_memo: Dict[int, np.ndarray] = {}
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._queues = [
@@ -133,51 +151,113 @@ class SampleAheadFeeder:
 
     # ------------------------------------------------------------ schedule
 
-    def _epoch_order(self, epoch: int) -> np.ndarray:
-        """This process's window order for `epoch` (thread-count-free).
+    def _compute_order(self, epoch: int, n_windows: int) -> np.ndarray:
+        """This process's window order for `epoch` over an `n_windows`
+        corpus — a pure function of (seed, epoch, n_windows), so every
+        feeder that sees the same corpus at epoch e draws the same order
+        no matter when the corpus reached that size."""
+        order = np.arange(n_windows)
+        if self.shuffle:
+            np.random.default_rng([self.seed, epoch]).shuffle(order)
+        return order[self.process_index :: self.process_count]
 
-        Memoized per instance: workers straddle at most two epochs at a
-        time, and the memo keeps the per-epoch shuffle O(n log n) once
-        instead of once per batch. Workers only read the cached arrays.
-        """
+    def _materialize_next_epoch_locked_unsafe(self) -> None:
+        """Append the next epoch's snapshot; caller holds `_order_lock`
+        (or is the constructor). Refresh happens HERE — at the boundary,
+        exactly once per epoch, under the lock — so the whole epoch is
+        drawn from one corpus snapshot."""
+        e = len(self._epochs)
+        if e > 0 and self.refresh_at_epoch:
+            try:
+                self.cache.refresh()
+            except Exception:  # noqa: BLE001 - keep feeding the old view
+                pass
+        n_windows = len(self.cache.index)
+        order = self._compute_order(e, n_windows)
+        first = (
+            0
+            if e == 0
+            else self._firsts[-1] + self._epochs[-1]["batches"]
+        )
+        self._epochs.append(
+            {
+                "first": first,
+                "batches": len(order) // self.batch_size,
+                "order": order,
+                "windows": n_windows,
+            }
+        )
+        self._firsts.append(first)
+        # Workers straddle at most a couple of epochs (bounded by queue
+        # depth); drop older order arrays to bound memory — they are
+        # recomputable from the pinned window count if ever needed.
+        for old in self._epochs[: max(0, e - 2)]:
+            old["order"] = None
+
+    def _locate(self, ticket: int) -> Tuple[int, int]:
+        """Global ticket -> (epoch, batch-in-epoch), materializing epoch
+        snapshots (and boundary refreshes) as the schedule reaches them."""
         with self._order_lock:
-            order = self._order_memo.get(epoch)
-            if order is None:
-                order = np.arange(len(self.cache.index))
-                if self.shuffle:
-                    np.random.default_rng([self.seed, epoch]).shuffle(order)
-                order = order[self.process_index :: self.process_count]
-                self._order_memo[epoch] = order
-                for stale in [e for e in self._order_memo if e < epoch - 1]:
-                    del self._order_memo[stale]
-        return order
+            while (
+                ticket
+                >= self._firsts[-1] + self._epochs[-1]["batches"]
+            ):
+                self._materialize_next_epoch_locked_unsafe()
+            e = bisect.bisect_right(self._firsts, ticket) - 1
+            return e, ticket - self._firsts[e]
 
-    def _ticket_indices(self, ticket: int) -> np.ndarray:
-        epoch, b = divmod(ticket, self.batches_per_epoch)
-        order = self._epoch_order(epoch)
-        return order[b * self.batch_size : (b + 1) * self.batch_size]
+    def _order_for(self, epoch: int) -> np.ndarray:
+        with self._order_lock:
+            while len(self._epochs) <= epoch:
+                self._materialize_next_epoch_locked_unsafe()
+            entry = self._epochs[epoch]
+            if entry["order"] is None:
+                entry["order"] = self._compute_order(
+                    epoch, entry["windows"]
+                )
+            return entry["order"]
 
-    def _ticket_rng(self, ticket: int) -> np.random.Generator:
-        # Philox keyed directly on (seed, ticket): counter-based, so
-        # construction is ~10us vs ~500us for default_rng's SeedSequence
-        # entropy pooling — this runs once per batch on the hot path. The
-        # 0x5EED word keeps the stream disjoint from the shuffle rng.
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """This process's window order for `epoch` (thread-count-free)."""
+        return self._order_for(epoch)
+
+    def _past_end(self, ticket: int) -> bool:
+        if self.num_epochs is None:
+            return False
+        if self.total_batches is not None:
+            return ticket >= self.total_batches
+        epoch, _ = self._locate(ticket)
+        return epoch >= self.num_epochs
+
+    def _batch_rng(self, epoch: int, b: int) -> np.random.Generator:
+        # Philox keyed directly on (seed, epoch, batch-in-epoch):
+        # counter-based, so construction is ~10us vs ~500us for
+        # default_rng's SeedSequence entropy pooling — this runs once per
+        # batch on the hot path. Keying on the epoch-local coordinates
+        # (not the flat ticket) makes each epoch's draws independent of
+        # how many batches earlier epochs had — the property that lets a
+        # flywheel feeder that grew mid-run match one built after the
+        # append. The 0x5EED word keeps the stream disjoint from the
+        # shuffle rng.
         key = (self.seed & 0xFFFFFFFFFFFFFFFF) ^ (0x5EED << 48)
+        counter = (np.uint64(epoch) << np.uint64(32)) | np.uint64(b)
         return np.random.Generator(
-            np.random.Philox(key=np.array([key, ticket], np.uint64))
+            np.random.Philox(key=np.array([key, counter], np.uint64))
         )
 
     # ------------------------------------------------------------ workers
 
     def _assemble(self, ticket: int) -> Dict:
-        indices = self._ticket_indices(ticket)
-        rng = self._ticket_rng(ticket)
-        b, w = len(indices), self.cache.window
+        epoch, b = self._locate(ticket)
+        order = self._order_for(epoch)
+        indices = order[b * self.batch_size : (b + 1) * self.batch_size]
+        rng = self._batch_rng(epoch, b)
+        n, w = len(indices), self.cache.window
         h, wd = self.cache.height, self.cache.width
-        images = np.empty((b, w, h, wd, 3), np.uint8)
-        embeds = np.empty((b, w, self._embed_dim), np.float32)
-        terms = np.empty((b, w), np.int32)
-        actions = np.empty((b, w, self._action_dim), np.float32)
+        images = np.empty((n, w, h, wd, 3), np.uint8)
+        embeds = np.empty((n, w, self._embed_dim), np.float32)
+        terms = np.empty((n, w), np.int32)
+        actions = np.empty((n, w, self._action_dim), np.float32)
         self.cache.fill_batch(indices, rng, images, embeds, terms, actions)
         observations = {
             "image": images,
@@ -203,7 +283,7 @@ class SampleAheadFeeder:
         q = self._queues[k]
         try:
             while not self._stop.is_set():
-                if self.total_batches is not None and ticket >= self.total_batches:
+                if self._past_end(ticket):
                     return
                 # resilience: deterministic fault sites (one global read
                 # when no plan is installed). feeder_hang dies silently —
@@ -261,6 +341,8 @@ class SampleAheadFeeder:
             "queue_capacity": self.num_threads * self.depth,
             "next_ticket": self._next_ticket,
             "workers_alive": sum(t.is_alive() for t in self._threads),
+            "corpus_windows": len(self.cache.index),
+            "epochs_started": len(self._epochs),
         }
         for k in range(self.num_threads):
             n = self._assembled[k]
@@ -269,6 +351,27 @@ class SampleAheadFeeder:
                 self._assembly_s[k] / n * 1e3 if n else 0.0
             )
         return out
+
+    def flywheel_stats(self) -> Dict[str, float]:
+        """Corpus-growth gauges for the train loop's `flywheel/*` scalars
+        and the `rt1_flywheel_*` Prometheus families: shard count,
+        freshness epoch, corpus size, appended-episode count, and how
+        stale the feeder's view of the manifest is. Lock-free reads."""
+        c = self.cache
+        now = time.time()
+        return {
+            "shards": float(getattr(c, "num_shards", 1)),
+            "freshness_epoch": float(getattr(c, "freshness_epoch", 0)),
+            "corpus_windows": float(len(c.index)),
+            "corpus_steps": float(getattr(c, "total_steps", 0)),
+            "corpus_episodes": float(len(c.episodes)),
+            "appended_episodes": float(getattr(c, "appended_episodes", 0)),
+            "refreshes": float(getattr(c, "refreshes", 0)),
+            "staleness_s": max(
+                0.0, now - getattr(c, "last_refresh_unix", now)
+            ),
+            "epochs_started": float(len(self._epochs)),
+        }
 
     # ------------------------------------------------------------ lifecycle
 
@@ -316,7 +419,7 @@ class SampleAheadFeeder:
         if self._stop.is_set():
             self._raise_or_stop()
         t = self._next_ticket
-        if self.total_batches is not None and t >= self.total_batches:
+        if self._past_end(t):
             raise StopIteration
         q = self._queues[t % self.num_threads]
         waited = 0.0

@@ -1,0 +1,23 @@
+"""rt1_tpu.flywheel — serve traffic back into the training corpus.
+
+The data flywheel closes the collect -> train -> serve loop (docs/data.md
+"Sharded pack format v2 & the flywheel"): served sessions are the corpus.
+
+* :mod:`rt1_tpu.flywheel.capture` — the serve-side episode-capture sink:
+  an opt-in, bounded ring of completed sessions written as standard
+  episode `.npz` files (`rt1_tpu/data/episodes.py` schema), plus the
+  fleet sweep that funnels per-replica capture dirs into one staging dir.
+* `rt1_tpu/data/pack.py::append_shard` — turns a staging dir into a new
+  pack shard with an atomically bumped `freshness_epoch`.
+* `rt1_tpu/data/feeder.py::SampleAheadFeeder(refresh_at_epoch=True)` —
+  a running train job picks the new shard up at the next epoch boundary,
+  no restart.
+
+Import hygiene matches `rt1_tpu.obs`: stdlib + numpy only at module scope —
+the capture sink runs inside serve replicas and the sweep inside the
+model-free fleet supervisor (pinned by tests/test_obs_imports.py).
+"""
+
+from rt1_tpu.flywheel.capture import EpisodeCaptureSink, sweep_captures
+
+__all__ = ["EpisodeCaptureSink", "sweep_captures"]

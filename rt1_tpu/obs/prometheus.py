@@ -159,6 +159,18 @@ _SERVE_COUNTERS = {
         "Sessions re-homed to another replica after theirs died."
     ),
     "batches_total": "Batched device steps executed.",
+    # Data-flywheel capture sink (rt1_tpu/flywheel/capture.py) — present
+    # only on replicas serving with --capture_dir.
+    "capture_episodes_total": "Captured sessions written as episodes.",
+    "capture_steps_total": "Steps written into captured episodes.",
+    "capture_dropped_episodes_total": (
+        "Sessions discarded (too short / no resolvable instruction)."
+    ),
+    "capture_dropped_steps_total": (
+        "Steps dropped past the per-session capture bound."
+    ),
+    "capture_write_errors_total": "Episode writes that failed (kept serving).",
+    "capture_pruned_total": "Old capture files pruned by the disk ring.",
 }
 
 _SERVE_HISTOGRAMS = {
@@ -255,6 +267,15 @@ _FLEET_REPLICA_FIELDS = {
     "param_bytes_master": (
         "gauge",
         "f32 master checkpoint bytes this replica restores from.",
+    ),
+    "capture_enabled": ("gauge", "1 when the flywheel capture sink is on."),
+    "capture_episodes_total": (
+        "counter",
+        "Captured sessions written as flywheel episodes.",
+    ),
+    "capture_open_sessions": (
+        "gauge",
+        "Capture buffers currently open on this replica.",
     ),
 }
 

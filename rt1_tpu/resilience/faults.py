@@ -30,6 +30,13 @@ Spec grammar (comma-separated)::
                          supervisor's hang detector kills and respawns it
     serve_reload@4       serve fleet: start a rolling checkpoint reload
                          (one replica at a time) at chaos tick 4
+    capture_write@2      flywheel: raise OSError on the 2nd episode the
+                         serve-side capture sink tries to write (the sink
+                         must drop the episode and keep serving)
+    pack_append@1        flywheel: raise OSError on the 1st pack append,
+                         AFTER the shard files land but BEFORE the
+                         manifest rename — the torn-append window readers
+                         must be immune to (rt1_tpu/data/pack.py)
     <site>@<n>x<k>       fire on k consecutive occurrences starting at n
                          (e.g. nan_batch@3x4 poisons batches 3,4,5,6)
 
@@ -79,6 +86,8 @@ KNOWN_SITES = (
     "replica_kill",
     "replica_hang",
     "serve_reload",
+    "capture_write",
+    "pack_append",
 )
 
 

@@ -102,12 +102,13 @@ def main(argv):
             "--allow_embedder_mismatch to override",
             manifest_name="data_manifest.json",
         )
+    embedder = get_embedder(FLAGS.embedder)
     engine, step = build_serve_engine(
         config,
         workdir=None if FLAGS.random_init else FLAGS.workdir,
         inference_dtype=FLAGS.inference_dtype,
         max_sessions=FLAGS.max_sessions,
-        embedder=get_embedder(FLAGS.embedder),
+        embedder=embedder,
     )
 
     # Standby restore source for zero-downtime hot-swap (POST /reload and
@@ -123,6 +124,21 @@ def main(argv):
             config, workdir=reload_workdir, step=reload_step
         )
 
+    # Data-flywheel episode capture (rt1_tpu/flywheel/): opt-in via
+    # --capture_dir. The sink shares the engine's embedder instance so
+    # text-only clients still yield embeddable episodes without loading
+    # the embedding model a second time.
+    capture = None
+    if FLAGS.capture_dir:
+        from rt1_tpu.flywheel import EpisodeCaptureSink
+
+        capture = EpisodeCaptureSink(
+            FLAGS.capture_dir,
+            max_episodes=FLAGS.capture_max_episodes,
+            max_steps=FLAGS.capture_max_steps,
+            embed_fn=embedder,
+        )
+
     app = ServeApp(
         engine,
         image_shape=(config.data.height, config.data.width, 3),
@@ -134,6 +150,7 @@ def main(argv):
         reload_fn=reload_fn,
         slow_threshold_ms=FLAGS.slow_threshold_ms,
         exemplar_path=FLAGS.exemplar_path or None,
+        capture=capture,
     )
     app.start(warmup=True)
     if FLAGS.watch_checkpoints_s > 0 and not FLAGS.random_init:
@@ -226,6 +243,18 @@ if __name__ == "__main__":
     flags.DEFINE_string(
         "exemplar_path", "",
         "Dump the slow-request exemplar ring here (JSONL) on drain.")
+    flags.DEFINE_string(
+        "capture_dir", "",
+        "Data flywheel: capture completed sessions as episode .npz files "
+        "into this directory (rt1_tpu/flywheel/capture.py). OFF by "
+        "default — serving records nothing unless an operator opts in.")
+    flags.DEFINE_integer(
+        "capture_max_episodes", 512,
+        "Capture disk ring: keep at most this many episode files "
+        "(oldest pruned).")
+    flags.DEFINE_integer(
+        "capture_max_steps", 512,
+        "Capture per-session step bound; steps beyond it are dropped.")
     flags.DEFINE_bool("verbose", False, "Log per-request lines.")
     flags.mark_flags_as_required(["config"])
     sys.exit(absl_app.run(main))

@@ -73,6 +73,7 @@ class FleetSupervisor:
         log_dir: Optional[str] = None,
         extra_env: Optional[Dict[str, str]] = None,
         exemplar_scrape_interval_s: float = 2.0,
+        capture_root: Optional[str] = None,
     ):
         self.router = router
         self._spawn_argv_fn = spawn_argv_fn
@@ -107,6 +108,13 @@ class FleetSupervisor:
         # (fleet main's final status line, while the scraper still runs).
         self._exemplar_lock = threading.Lock()
         self.last_exemplars: Dict[int, Dict[str, Any]] = {}
+        # Data flywheel: each replica captures episodes into
+        # <capture_root>/replica_<id>; the scrape loop sweeps completed
+        # files into <capture_root>/staging — ONE dir the packer appends
+        # from (`scripts/pack_dataset.py --append`), fed by N replicas
+        # that keep writing across kills and respawns.
+        self.capture_root = capture_root
+        self.captures_swept = 0
 
     # ------------------------------------------------------------ spawning
 
@@ -317,6 +325,7 @@ class FleetSupervisor:
         while not self._stop.is_set():
             try:
                 self._scrape_exemplars()
+                self.sweep_captures()
             except Exception as exc:  # noqa: BLE001 - keep scraping
                 print(
                     json.dumps(
@@ -349,6 +358,34 @@ class FleetSupervisor:
                     body["scraped_at"] = time.time()
                     body["generation"] = replica.restarts
                     self.last_exemplars[replica.id] = body
+
+    def replica_capture_dir(self, replica_id: int) -> Optional[str]:
+        if self.capture_root is None:
+            return None
+        return os.path.join(self.capture_root, f"replica_{replica_id}")
+
+    def capture_staging_dir(self) -> Optional[str]:
+        if self.capture_root is None:
+            return None
+        return os.path.join(self.capture_root, "staging")
+
+    def sweep_captures(self) -> int:
+        """Move completed per-replica capture files into the staging dir
+        (same-filesystem renames; a SIGKILLed replica's already-renamed
+        episodes survive it, exactly like the exemplar scrape)."""
+        if self.capture_root is None:
+            return 0
+        from rt1_tpu.flywheel.capture import sweep_captures
+
+        moved = sweep_captures(
+            [
+                self.replica_capture_dir(r.id)
+                for r in self.router.replicas()
+            ],
+            self.capture_staging_dir(),
+        )
+        self.captures_swept += moved
+        return moved
 
     def _respawn(self, replica: Replica) -> None:
         if self.restarts_total >= self.max_restarts:
@@ -420,6 +457,7 @@ class FleetSupervisor:
             "hangs_injected": self.hangs_injected,
             "reloads_injected": self.reloads_injected,
             "replica_restarts": self.restarts_total,
+            "captures_swept": self.captures_swept,
             "faults_fired": (
                 faults.active().fired_counts() if faults.active() else {}
             ),
@@ -493,6 +531,8 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
             ]
         return build
 
+    capture_root = getattr(args, "capture_dir", "")
+
     def build(replica_id: int) -> List[str]:
         argv = [
             sys.executable, "-m", "rt1_tpu.serve",
@@ -504,6 +544,13 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
             "--slow_threshold_ms", str(slow_threshold),
             "--inference_dtype", replica_dtype_for(args, replica_id),
         ]
+        if capture_root:
+            # Per-replica capture dir; the supervisor sweeps completed
+            # files into <capture_dir>/staging for the packer.
+            argv.extend([
+                "--capture_dir",
+                os.path.join(capture_root, f"replica_{replica_id}"),
+            ])
         if args.random_init:
             argv.append("--random_init")
         else:
@@ -545,6 +592,12 @@ def main(argv=None) -> int:
         help="Comma list assigning a dtype per replica id (cycled), e.g. "
              "'f32,int8,int8' — a mixed-dtype fleet; overrides "
              "--inference_dtype.")
+    parser.add_argument(
+        "--capture_dir", default="",
+        help="Data flywheel: per-replica episode capture under "
+             "<dir>/replica_<id>, swept into <dir>/staging by the "
+             "supervisor (real replicas only; the model-free stub serves "
+             "no observations worth capturing).")
     parser.add_argument(
         "--slow_threshold_ms", type=float, default=0.0,
         help="Replica exemplar-ring threshold, forwarded to every "
@@ -603,6 +656,7 @@ def main(argv=None) -> int:
         poll_interval_s=args.poll_interval_s,
         warmup_timeout_s=args.warmup_timeout_s,
         log_dir=args.log_dir or None,
+        capture_root=(args.capture_dir or None) if not args.stub else None,
     )
     supervisor.start(wait_ready=True)
     httpd = make_router_server(
@@ -651,6 +705,10 @@ def main(argv=None) -> int:
             "slow_requests": supervisor.slow_request_evidence(),
         }
         supervisor.stop()
+        # Replicas drained on SIGTERM (writing their in-flight capture
+        # buffers); one last sweep moves those into staging.
+        supervisor.sweep_captures()
+        final["chaos"]["captures_swept"] = supervisor.captures_swept
         print(json.dumps(final), flush=True)
     return 0
 

@@ -149,8 +149,14 @@ class ServeApp:
         slow_threshold_ms: float = 0.0,
         slow_capacity: int = 128,
         exemplar_path: Optional[str] = None,
+        capture=None,
     ):
         self.engine = engine
+        # Opt-in data-flywheel episode capture
+        # (rt1_tpu/flywheel/capture.py::EpisodeCaptureSink, wired from
+        # `--capture_dir`). None — the default — leaves every serve path
+        # byte-identical: the hot path pays one `is None` check.
+        self.capture = capture
         self.image_shape = tuple(image_shape)
         self.embed_dim = embed_dim
         self.metrics = metrics if metrics is not None else ServeMetrics()
@@ -240,11 +246,14 @@ class ServeApp:
         session_id: str,
         obs: Dict[str, Any],
         phases: Optional[reqtrace.RequestPhases] = None,
+        task: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Blocking bridge used by HTTP handler threads. `phases` rides
         the batcher item so every boundary thread stamps the same ledger
         (a direct caller without one still gets a fresh ledger — the
-        batcher hooks unconditionally dereference it)."""
+        batcher hooks unconditionally dereference it). `task` is the
+        client-declared workload tag the capture sink stamps into
+        flywheel episodes."""
         if phases is None:
             phases = reqtrace.RequestPhases()
         with self._admit_lock:
@@ -269,7 +278,36 @@ class ServeApp:
             # The engine isolates a bad item as a per-item marker so its
             # batchmates still step; surface it to THIS request only.
             raise result["error"]
+        if self.capture is not None:
+            # After the engine answered: capture sees only successfully
+            # served steps, and a sink failure can never fail the request
+            # (record_step swallows its own errors into a counter).
+            self.capture.record_step(
+                session_id,
+                image=obs["image"],
+                action=result["action"],
+                action_tokens=result.get("action_tokens"),
+                embedding=obs.get("natural_language_embedding"),
+                instruction=obs.get("instruction"),
+                task=task,
+                session_started=result.get("session_started", False),
+                terminate=bool(result.get("terminate_episode", 0)),
+            )
         return result
+
+    def reset(self, session_id: str) -> int:
+        """Engine reset + capture boundary: a client-requested fresh
+        window ends the captured episode in flight."""
+        slot = self.engine.reset(session_id)
+        if self.capture is not None:
+            self.capture.finalize(session_id, "reset")
+        return slot
+
+    def release(self, session_id: str) -> None:
+        """Engine release + capture finalize (outcome "released")."""
+        self.engine.release(session_id)
+        if self.capture is not None:
+            self.capture.finalize(session_id, "released")
 
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful shutdown: reject new work, flush everything admitted.
@@ -289,6 +327,10 @@ class ServeApp:
             ).result(timeout=timeout)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=timeout)
+        if self.capture is not None:
+            # Sessions cut off by shutdown are still served data — write
+            # them (outcome "drain") before the process exits.
+            self.capture.drain()
         if self.exemplar_path and len(self.exemplars):
             try:
                 self.exemplars.dump(self.exemplar_path, reason="drain")
@@ -385,6 +427,13 @@ class ServeApp:
             "param_bytes_master": getattr(
                 self.engine, "master_param_bytes", 0
             ),
+            # Flywheel capture gauges (rt1_serve_capture_*): enabled flag
+            # always present so dashboards can tell "off" from "zero".
+            **(
+                self.capture.stats()
+                if self.capture is not None
+                else {"capture_enabled": 0}
+            ),
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -475,10 +524,10 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/act":
             self._act(payload)
         elif self.path == "/reset":
-            self._session_op(payload, self.app.engine.reset, "slot",
+            self._session_op(payload, self.app.reset, "slot",
                              count_reset=True)
         elif self.path == "/release":
-            self._session_op(payload, self.app.engine.release, None)
+            self._session_op(payload, self.app.release, None)
         elif self.path == "/reload":
             self._reload(payload)
         else:
@@ -565,7 +614,11 @@ class _Handler(BaseHTTPRequestHandler):
                 obs = parse_observation(
                     payload, self.app.image_shape, self.app.embed_dim
                 )
-                result = self.app.act(session_id, obs, phases)
+                task = payload.get("task")
+                result = self.app.act(
+                    session_id, obs, phases,
+                    task=task if isinstance(task, str) else None,
+                )
             except RequestError as exc:
                 self._fail_act(400, phases, session_id, t0,
                                "failed", {"error": str(exc)})

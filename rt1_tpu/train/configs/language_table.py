@@ -126,6 +126,14 @@ def get_config():
     config.data.feeder_stall_timeout_s = ml_collections.config_dict.placeholder(
         float
     )
+    # Data flywheel (docs/data.md "Sharded pack format v2 & the
+    # flywheel"): at every epoch boundary the train feeder re-reads the
+    # pack manifest and picks up shards appended by
+    # `scripts/pack_dataset.py --append` (serve-captured episodes) without
+    # a restart; `flywheel/*` scalars + rt1_flywheel_* gauges track shard
+    # count, corpus size, and staleness. Costs one manifest read per data
+    # epoch when nothing changed.
+    config.data.packed_refresh = True
 
     # Training schedule (reference: 100 epochs x 975 steps at batch 8).
     config.per_host_batch_size = 8

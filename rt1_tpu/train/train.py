@@ -269,19 +269,21 @@ def _packed_batches(
     pack_dir = config.data.get("packed_cache_dir") or pack_lib.default_pack_dir(
         config.data.data_dir, split
     )
-    if not pack_lib.pack_is_fresh(
+    fresh, reason = pack_lib.pack_status(
         pack_dir,
         paths,
         config.data.height,
         config.data.width,
         config.data.crop_factor,
-    ):
+    )
+    if not fresh:
         logging.warning(
-            "data.packed_cache=True but %s is missing or stale for this "
-            "episode set/geometry — falling back to the '%s' loader. Build "
-            "it with: python scripts/pack_dataset.py --data_dir %s --split "
-            "%s --height %d --width %d --crop_factor %s",
+            "data.packed_cache=True but %s is missing or stale (%s) — "
+            "falling back to the '%s' loader. Build it with: python "
+            "scripts/pack_dataset.py --data_dir %s --split %s --height %d "
+            "--width %d --crop_factor %s",
             pack_dir,
+            reason,
             config.data.loader,
             config.data.data_dir,
             split,
@@ -323,6 +325,12 @@ def _packed_batches(
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         stall_timeout_s=config.data.get("feeder_stall_timeout_s"),
+        # Data flywheel: re-read the pack manifest at epoch boundaries and
+        # pick up appended shards mid-run (train split only — eval streams
+        # should stay pinned to one corpus).
+        refresh_at_epoch=(
+            split == "train" and config.data.get("packed_refresh", False)
+        ),
         name="feeder_construct",
     )
 
@@ -660,6 +668,10 @@ def train_and_evaluate(config, workdir: str):
     )
     # Feeder-side gauges when the packed sample-ahead feeder is the source.
     feeder_stats = getattr(train_iter, "stats", None)
+    # Flywheel corpus gauges (shards / freshness epoch / corpus size /
+    # staleness): the feeder exposes them when it feeds from the packed
+    # cache; rendered as rt1_flywheel_* on the scrape and flywheel/* in TB.
+    flywheel_stats = getattr(train_iter, "flywheel_stats", None)
 
     recorder = None
     if obs_opts.flight_recorder:
@@ -715,7 +727,15 @@ def train_and_evaluate(config, workdir: str):
             # latest_scalars from the last log step).
             if ledger is not None:
                 scalars.update(ledger.scalars())
-            return obs.prometheus.render_scalar_gauges(scalars)
+            body = obs.prometheus.render_scalar_gauges(scalars)
+            # rt1_flywheel_*: live corpus-growth gauges — a scrape during
+            # an epoch shows the shard pickup the moment the feeder takes
+            # it, independent of the log-step cadence.
+            if flywheel_stats is not None:
+                body += obs.prometheus.render_scalar_gauges(
+                    flywheel_stats(), prefix="rt1_flywheel_"
+                )
+            return body
 
         metrics_server = obs.MetricsServer(
             _render_prometheus,
@@ -892,6 +912,13 @@ def train_and_evaluate(config, workdir: str):
                             for k, v in feeder_stats().items()
                         }
                     )
+                if flywheel_stats is not None:
+                    scalars.update(
+                        {
+                            f"flywheel/{k}": v
+                            for k, v in flywheel_stats().items()
+                        }
+                    )
                 scalars.update(resilience.retry.counters())
                 if coordinator is not None:
                     scalars.update(coordinator.counters())
@@ -956,6 +983,7 @@ def train_and_evaluate(config, workdir: str):
                     train_iter = synthetic_batches(config, fresh_seed)
                 live_iter["host"] = train_iter
                 feeder_stats = getattr(train_iter, "stats", None)
+                flywheel_stats = getattr(train_iter, "flywheel_stats", None)
                 dev_iter = device_feeder(
                     _host_stream(train_iter), fns.batch_sharding, depth=2
                 )

@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""Data-flywheel driver: serve traffic -> captured episodes -> appended pack
+shard -> a LIVE train job absorbs it mid-run. Writes BENCH_flywheel.json.
+
+The end-to-end proof for ISSUE 10 (ROADMAP item 3), on CPU with the tiny
+config, in four acts:
+
+1. **Seed corpus.** Synthetic episodes at the tiny config's serve/train
+   geometry (32x56), packed into the sharded cache (one base shard).
+2. **Serve with capture.** One real replica (`python -m rt1_tpu.serve
+   --random_init --capture_dir ...`) serves N deterministic sessions; each
+   `/release` writes a standard episode `.npz` into the capture dir —
+   observations, actions, action tokens, the `task` tag, the outcome.
+3. **Torn-append chaos.** With `pack_append@1` armed, `append_shard` dies
+   AFTER the shard files land and BEFORE the manifest rename; the driver
+   asserts readers still see the intact one-shard corpus (the satellite's
+   "a torn append never corrupts the manifest readers see"). The retry
+   then appends the captured episodes for real: shards 1 -> 2,
+   freshness_epoch 0 -> 1.
+4. **Live pickup.** A train job launched BEFORE the append (packed feeder,
+   `data.packed_refresh=True`, Prometheus scrape port) is polled for its
+   `rt1_flywheel_corpus_windows` / `rt1_flywheel_corpus_steps` gauges: the
+   driver asserts the corpus STRICTLY grows mid-run — the feeder picked
+   the new shard up at an epoch boundary with no restart — then SIGTERMs
+   the job (preemption save-and-exit, rc 0).
+
+Run:
+    JAX_PLATFORMS=cpu python scripts/flywheel_loop.py \
+        --workdir /tmp/rt1_flywheel --bench_out BENCH_flywheel.json
+"""
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/flywheel_loop.py`
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+TINY_CONFIG = os.path.join(_REPO, "rt1_tpu/train/configs/tiny.py")
+SRC_H, SRC_W = 32, 56  # == tiny config data.height/width: capture and
+#                          corpus share one source geometry by design.
+
+
+def _post(url, payload, timeout=30.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _read_ready_line(proc, timeout_s=240.0):
+    """Parse the replica's `{"status": "serving", "port": ...}` line."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"subprocess exited rc={proc.returncode} before ready"
+                )
+            time.sleep(0.1)
+            continue
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if msg.get("status") == "serving":
+            return msg
+    raise TimeoutError("no ready line within the timeout")
+
+
+def _build_corpus(data_dir, episodes, steps, seed=0):
+    from rt1_tpu.data.episodes import (
+        encode_instruction_text,
+        generate_synthetic_episode,
+        save_episode,
+    )
+
+    train = os.path.join(data_dir, "train")
+    os.makedirs(train, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(episodes):
+        ep = generate_synthetic_episode(
+            rng, num_steps=steps, height=SRC_H, width=SRC_W
+        )
+        ep["task"] = encode_instruction_text("seed_corpus")
+        path = os.path.join(train, f"episode_{i}.npz")
+        save_episode(path, ep)
+        paths.append(path)
+    return paths
+
+
+def _scrape_flywheel(port):
+    """{gauge: value} for the rt1_flywheel_* families on the train scrape."""
+    try:
+        text = _get(f"http://127.0.0.1:{port}/metrics", timeout=5.0)
+    except (urllib.error.URLError, OSError):
+        return None
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("rt1_flywheel_"):
+            name, value = line.rsplit(" ", 1)
+            out[name] = float(value)
+    return out or None
+
+
+def _serve_and_capture(args, capture_dir, log_dir):
+    """Act 2: one replica with capture on; returns the serve record."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    stderr = open(os.path.join(log_dir, "serve.log"), "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "rt1_tpu.serve",
+            "--config", TINY_CONFIG,
+            "--random_init",
+            "--port", "0",
+            "--max_sessions", str(max(4, args.sessions)),
+            "--capture_dir", capture_dir,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=stderr,
+        text=True,
+        env=env,
+        cwd=_REPO,
+    )
+    record = {"sessions": args.sessions, "steps_per_session": args.steps}
+    try:
+        ready = _read_ready_line(proc)
+        url = f"http://127.0.0.1:{ready['port']}"
+        rng = np.random.default_rng(7)
+        embedding = [
+            float(x) for x in rng.standard_normal(512).astype(np.float32)
+        ]
+        ok = 0
+        for s in range(args.sessions):
+            sid = f"fly-{s}"
+            _post(url + "/reset", {"session_id": sid})
+            for _ in range(args.steps):
+                frame = rng.integers(
+                    0, 256, (SRC_H, SRC_W, 3), dtype=np.uint8
+                )
+                resp = _post(
+                    url + "/act",
+                    {
+                        "session_id": sid,
+                        "image_b64": base64.b64encode(
+                            frame.tobytes()
+                        ).decode("ascii"),
+                        "embedding": embedding,
+                        "task": "flywheel_demo",
+                    },
+                )
+                assert "action" in resp, resp
+                ok += 1
+            _post(url + "/release", {"session_id": sid})
+        metrics = json.loads(_get(url + "/metrics"))
+        record.update(
+            requests_ok=ok,
+            compile_count=metrics.get("compile_count"),
+            capture_episodes=metrics.get("capture_episodes_total"),
+            capture_steps=metrics.get("capture_steps_total"),
+            capture_write_errors=metrics.get("capture_write_errors_total"),
+        )
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        stderr.close()
+    record["serve_exit_code"] = proc.returncode
+    return record
+
+
+def _torn_append_chaos(pack_dir, staged):
+    """Act 3a: prove the torn-append window is reader-safe."""
+    from rt1_tpu.data import pack as pack_lib
+    from rt1_tpu.resilience import faults
+
+    before = pack_lib.load_manifest(pack_dir)
+    faults.install_from("pack_append@1")
+    injected = False
+    try:
+        try:
+            pack_lib.append_shard(pack_dir, staged)
+        except OSError as exc:
+            injected = "pack_append" in str(exc)
+    finally:
+        faults.clear()
+    after = pack_lib.load_manifest(pack_dir)
+    intact = (
+        after["freshness_epoch"] == before["freshness_epoch"]
+        and len(after["shards"]) == len(before["shards"])
+        and pack_lib.verify_shards(pack_dir, after) == []
+    )
+    # The cache must open and read the old corpus through the torn window.
+    cache = pack_lib.PackedEpisodeCache(pack_dir, window=3)
+    cache.get_window(0, np.random.default_rng(0))
+    return {
+        "injected": injected,
+        "manifest_intact": intact,
+        "cache_loads": True,
+        "windows_visible": len(cache.index),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", default="/tmp/rt1_flywheel")
+    p.add_argument("--bench_out", default=os.path.join(
+        _REPO, "BENCH_flywheel.json"))
+    p.add_argument("--episodes", type=int, default=12,
+                   help="Seed-corpus episodes.")
+    p.add_argument("--episode_steps", type=int, default=8)
+    p.add_argument("--sessions", type=int, default=4,
+                   help="Served sessions to capture.")
+    p.add_argument("--steps", type=int, default=10,
+                   help="Steps per served session.")
+    p.add_argument("--pickup_timeout_s", type=float, default=240.0)
+    args = p.parse_args()
+
+    from rt1_tpu.data import pack as pack_lib
+    from rt1_tpu.flywheel.capture import capture_files
+
+    t_start = time.perf_counter()
+    wd = os.path.abspath(args.workdir)
+    shutil.rmtree(wd, ignore_errors=True)
+    data_dir = os.path.join(wd, "data")
+    capture_dir = os.path.join(wd, "capture")
+    log_dir = os.path.join(wd, "logs")
+    train_wd = os.path.join(wd, "train")
+    for d in (data_dir, capture_dir, log_dir, train_wd):
+        os.makedirs(d, exist_ok=True)
+
+    bench = {
+        "bench": "flywheel_e2e",
+        "description": (
+            "Closed collect->train->serve loop: a real replica captures "
+            "served sessions, the packer appends them as a new shard, and "
+            "a live tiny train job's feeder absorbs the shard at an epoch "
+            "boundary without restart (CPU, tiny config)."
+        ),
+        "config": {
+            "seed_episodes": args.episodes,
+            "episode_steps": args.episode_steps,
+            "sessions": args.sessions,
+            "steps_per_session": args.steps,
+            "geometry": [SRC_H, SRC_W],
+        },
+    }
+
+    # ---- Act 1: seed corpus + base pack
+    paths = _build_corpus(data_dir, args.episodes, args.episode_steps)
+    pack_dir = pack_lib.default_pack_dir(data_dir, "train")
+    manifest = pack_lib.pack_episodes(paths, pack_dir, SRC_H, SRC_W, 0.95)
+    windows_base = manifest["total_steps"]
+    print(json.dumps({"phase": "seed", "episodes": len(paths),
+                      "steps": manifest["total_steps"]}), flush=True)
+
+    # ---- Act 2: serve with capture
+    t0 = time.perf_counter()
+    bench["serve"] = _serve_and_capture(args, capture_dir, log_dir)
+    bench["serve"]["seconds"] = round(time.perf_counter() - t0, 1)
+    staged = capture_files(capture_dir)
+    bench["serve"]["captured_files"] = len(staged)
+    print(json.dumps({"phase": "serve", **bench["serve"]}), flush=True)
+    assert staged, "serve phase captured no episodes"
+    assert bench["serve"]["capture_episodes"] >= args.sessions
+
+    # ---- Act 4 setup: launch the train job BEFORE the append, so the
+    # append provably lands mid-run.
+    scrape_port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    train_log = open(os.path.join(log_dir, "train.log"), "w")
+    train_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "rt1_tpu.train.train",
+            "--config", TINY_CONFIG,
+            "--workdir", train_wd,
+            f"--config.data.data_dir={data_dir}",
+            "--config.data.packed_cache=True",
+            "--config.data.packed_refresh=True",
+            "--config.num_steps=1000000",
+            "--config.checkpoint_every_steps=5000",
+            "--config.log_every_steps=20",
+            "--config.eval_every_steps=0",
+            f"--config.obs.prometheus_port={scrape_port}",
+        ],
+        stdout=train_log,
+        stderr=subprocess.STDOUT,
+        env=env,
+        cwd=_REPO,
+    )
+    bench["train"] = {"scrape_port": scrape_port}
+    try:
+        # Wait until the job streams from the packed feeder (gauges live).
+        deadline = time.monotonic() + args.pickup_timeout_s
+        first = None
+        while time.monotonic() < deadline:
+            if train_proc.poll() is not None:
+                raise RuntimeError(
+                    f"train job died rc={train_proc.returncode} before the "
+                    f"scrape came up (see {log_dir}/train.log)"
+                )
+            first = _scrape_flywheel(scrape_port)
+            if first:
+                break
+            time.sleep(0.5)
+        assert first, "train flywheel gauges never appeared"
+        windows_before = first["rt1_flywheel_corpus_windows"]
+        steps_before = first["rt1_flywheel_corpus_steps"]
+        assert first["rt1_flywheel_shards"] == 1
+        assert steps_before == windows_base
+        bench["train"]["before"] = first
+        print(json.dumps({"phase": "train_up", **first}), flush=True)
+
+        # ---- Act 3: torn-append chaos, then the real append — both while
+        # the train job is live.
+        bench["torn_append"] = _torn_append_chaos(pack_dir, staged)
+        assert bench["torn_append"]["injected"]
+        assert bench["torn_append"]["manifest_intact"]
+        print(json.dumps({"phase": "torn_append",
+                          **bench["torn_append"]}), flush=True)
+
+        t0 = time.perf_counter()
+        manifest = pack_lib.append_shard(pack_dir, staged)
+        bench["pack"] = {
+            "shards_before": 1,
+            "shards_after": len(manifest["shards"]),
+            "freshness_epoch": manifest["freshness_epoch"],
+            "appended_episodes": manifest["shards"][-1]["episodes"],
+            "corpus_steps_after": manifest["total_steps"],
+            "append_seconds": round(time.perf_counter() - t0, 2),
+        }
+        print(json.dumps({"phase": "append", **bench["pack"]}), flush=True)
+        assert bench["pack"]["shards_after"] == 2
+
+        # ---- Act 4: the live job must absorb the shard at an epoch
+        # boundary: corpus windows/steps STRICTLY grow mid-run.
+        samples = []
+        grown = None
+        deadline = time.monotonic() + args.pickup_timeout_s
+        while time.monotonic() < deadline:
+            if train_proc.poll() is not None:
+                raise RuntimeError(
+                    "train job exited before picking up the shard "
+                    f"(rc={train_proc.returncode})"
+                )
+            snap = _scrape_flywheel(scrape_port)
+            if snap:
+                samples.append(
+                    {k.replace("rt1_flywheel_", ""): v
+                     for k, v in snap.items()}
+                )
+                if (
+                    snap["rt1_flywheel_corpus_windows"] > windows_before
+                    and snap["rt1_flywheel_shards"] == 2
+                ):
+                    grown = snap
+                    break
+            time.sleep(0.5)
+        assert grown is not None, (
+            "train job never picked the appended shard up "
+            f"(last: {samples[-1] if samples else None})"
+        )
+        bench["train"]["after"] = grown
+        bench["train"]["observed_growth_mid_run"] = True
+        bench["train"]["train_alive_at_growth"] = train_proc.poll() is None
+        bench["train"]["corpus_windows"] = [
+            windows_before, grown["rt1_flywheel_corpus_windows"]
+        ]
+        bench["train"]["corpus_steps"] = [
+            steps_before, grown["rt1_flywheel_corpus_steps"]
+        ]
+        bench["train"]["samples_polled"] = len(samples)
+        print(json.dumps({"phase": "pickup", **grown}), flush=True)
+    finally:
+        # Preemption path: SIGTERM -> force-save -> exit 0.
+        if train_proc.poll() is None:
+            train_proc.send_signal(signal.SIGTERM)
+            try:
+                train_proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                train_proc.kill()
+                train_proc.wait(timeout=10)
+        train_log.close()
+    bench["train"]["exit_code"] = train_proc.returncode
+    assert train_proc.returncode == 0, "train preempt exit was not clean"
+    assert (
+        bench["train"]["corpus_steps"][1]
+        > bench["train"]["corpus_steps"][0]
+    )
+
+    bench["total_seconds"] = round(time.perf_counter() - t_start, 1)
+    bench["verdict"] = "flywheel_closed"
+    with open(args.bench_out, "w") as f:
+        json.dump(bench, f, indent=2, sort_keys=True)
+    print(json.dumps({"phase": "done", "bench_out": args.bench_out,
+                      "total_seconds": bench["total_seconds"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,16 @@ if it is missing or stale).
   python scripts/pack_dataset.py --data_dir /data/lt --split train \
       --height 256 --width 456 --crop_factor 0.95
 
+Append mode (the data flywheel, docs/data.md): add newly collected or
+serve-captured episodes to an EXISTING pack as a new shard — geometry
+comes from the manifest, already-packed episodes are skipped by source
+fingerprint, and the manifest is atomically rewritten with a bumped
+freshness_epoch so a running train job's feeder picks the shard up at its
+next epoch boundary:
+
+  python scripts/pack_dataset.py --append \
+      --out_dir /data/lt/train_packed --episodes_dir /data/capture/staging
+
 Prints one JSON summary line per split (pack geometry, episode/frame
 counts, bytes written, wall time).
 """
@@ -28,8 +38,17 @@ def main():
     p = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
     )
-    p.add_argument("--data_dir", required=True,
-                   help="Episode store root (contains <split>/episode_*.np*).")
+    p.add_argument("--data_dir", default=None,
+                   help="Episode store root (contains <split>/episode_*.np*). "
+                        "Required unless --append with --out_dir.")
+    p.add_argument("--append", action="store_true",
+                   help="Append new episodes to an existing pack as a new "
+                        "shard (flywheel path); geometry flags are ignored "
+                        "— the manifest's geometry is corpus-wide.")
+    p.add_argument("--episodes_dir", default=None,
+                   help="With --append: directory holding the new "
+                        "episode_*.np* files (e.g. the fleet capture "
+                        "staging dir); default <data_dir>/<split>.")
     p.add_argument("--split", action="append", default=None,
                    help="Split(s) to pack (repeatable); default: train,val.")
     p.add_argument("--height", type=int, default=256)
@@ -49,6 +68,10 @@ def main():
         None if args.crop_factor.lower() in ("none", "null", "")
         else float(args.crop_factor)
     )
+    if args.append:
+        return _append(p, args, pack_lib)
+    if not args.data_dir:
+        p.error("--data_dir is required unless --append with --out_dir")
     splits = args.split or ["train", "val"]
     if args.out_dir and len(splits) != 1:
         p.error("--out_dir requires exactly one --split")
@@ -88,6 +111,52 @@ def main():
             "seconds": round(dt, 2),
         }))
     return rc
+
+
+def _append(p, args, pack_lib):
+    """`--append`: one shard of new episodes onto an existing pack."""
+    splits = args.split or ["train"]
+    if len(splits) != 1:
+        p.error("--append packs exactly one pack (one --split)")
+    split = splits[0]
+    if not args.out_dir and not args.data_dir:
+        p.error("--append needs --out_dir (or --data_dir to derive it)")
+    out_dir = args.out_dir or pack_lib.default_pack_dir(args.data_dir, split)
+    src_dir = args.episodes_dir or (
+        os.path.join(args.data_dir, split) if args.data_dir else None
+    )
+    if not src_dir:
+        p.error("--append needs --episodes_dir (or --data_dir)")
+    paths = sorted(glob.glob(os.path.join(src_dir, "episode_*.np*")))
+    if not paths:
+        print(json.dumps({"split": split, "error": "no_episodes",
+                          "dir": src_dir}))
+        return 1
+    t0 = time.perf_counter()
+    try:
+        before = pack_lib.load_manifest(out_dir)
+        shards_before = len(before["shards"])
+        manifest = pack_lib.append_shard(out_dir, paths)
+    except (OSError, ValueError) as exc:
+        # No base pack / unreadable manifest: keep the script's JSON-line
+        # contract instead of a raw traceback.
+        print(json.dumps({"split": split, "error": "append_failed",
+                          "out_dir": out_dir, "detail": str(exc)}))
+        return 1
+    dt = time.perf_counter() - t0
+    appended = manifest["shards"][shards_before:]
+    print(json.dumps({
+        "split": split,
+        "out_dir": out_dir,
+        "appended_episodes": sum(s["episodes"] for s in appended),
+        "appended_shards": [s["frames"] for s in appended],
+        "shards": len(manifest["shards"]),
+        "freshness_epoch": manifest["freshness_epoch"],
+        "total_steps": manifest["total_steps"],
+        "episodes": len(manifest["episodes"]),
+        "seconds": round(dt, 2),
+    }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -190,6 +190,36 @@ assert (
 assert 'rt1_serve_replica_param_bytes_device{replica_id="0"} 7' in dtype_text
 assert "rt1_serve_replica_inference_dtype" in fleet_metric_names()
 
+# ISSUE 10 data flywheel: the capture sink runs inside serve replicas and
+# the sweep inside the model-free fleet supervisor — importable and
+# functional under the blocker (numpy allowed; clu/TF are not).
+import tempfile as _tempfile
+
+from rt1_tpu.flywheel import EpisodeCaptureSink, sweep_captures
+
+with _tempfile.TemporaryDirectory() as _cap:
+    _sink = EpisodeCaptureSink(_cap, min_steps=1)
+    _sink.record_step(
+        "probe",
+        image=_np.zeros((4, 6, 3), _np.float32),
+        action=[0.0, 0.0],
+        embedding=_np.zeros((8,), _np.float32),
+    )
+    assert _sink.finalize("probe", "released")
+    assert _sink.stats()["capture_episodes_total"] == 1
+    with _tempfile.TemporaryDirectory() as _stage:
+        assert sweep_captures([_cap], _stage) == 1
+
+# The capture gauges render through the serve snapshot path, and the
+# flywheel gauges through the scalar renderer, all clu/TF-free.
+cap_text = ServeMetrics().prometheus_text(
+    capture_enabled=1, capture_episodes_total=1)
+assert "# TYPE rt1_serve_capture_episodes_total counter" in cap_text
+from rt1_tpu.obs.prometheus import render_scalar_gauges
+
+assert "rt1_flywheel_shards 2" in render_scalar_gauges(
+    {"shards": 2}, prefix="rt1_flywheel_")
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

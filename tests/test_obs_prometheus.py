@@ -382,3 +382,83 @@ def test_health_and_goodput_gauges_exposition():
     assert all(
         types[n] == "gauge" for n in by_name if n.startswith("rt1_train_")
     )
+
+
+def test_flywheel_and_capture_naming_contract():
+    """ISSUE 10 naming contract: serve-side capture counters/gauges render
+    as rt1_serve_capture_* through the one snapshot->text path (counters
+    typed counter, the rest gauges), the fleet aggregation names the
+    capture fields, and train-side flywheel corpus gauges render under
+    their own rt1_flywheel_ prefix next to the rt1_train_ body."""
+    text = ServeMetrics().prometheus_text(
+        capture_enabled=1,
+        capture_episodes_total=3,
+        capture_steps_total=9,
+        capture_dropped_episodes_total=1,
+        capture_dropped_steps_total=2,
+        capture_write_errors_total=0,
+        capture_pruned_total=0,
+        capture_open_sessions=2,
+    )
+    types, samples = parse_exposition(text)
+    for counter in (
+        "rt1_serve_capture_episodes_total",
+        "rt1_serve_capture_steps_total",
+        "rt1_serve_capture_dropped_episodes_total",
+        "rt1_serve_capture_dropped_steps_total",
+        "rt1_serve_capture_write_errors_total",
+        "rt1_serve_capture_pruned_total",
+    ):
+        assert types[counter] == "counter", counter
+    assert types["rt1_serve_capture_enabled"] == "gauge"
+    assert types["rt1_serve_capture_open_sessions"] == "gauge"
+    by_name = {n: float(v) for n, _, v in samples}
+    assert by_name["rt1_serve_capture_episodes_total"] == 3.0
+
+    # Fleet aggregation: the scrape-config contract names the capture
+    # fields, and they render labeled when a replica carries them.
+    names = prom.fleet_metric_names()
+    assert "rt1_serve_replica_capture_enabled" in names
+    assert "rt1_serve_replica_capture_episodes_total" in names
+    assert "rt1_serve_replica_capture_open_sessions" in names
+    fleet = prom.render_fleet_snapshot(
+        {}, {1: {"capture_enabled": 1, "capture_episodes_total": 4.0}}
+    )
+    assert (
+        'rt1_serve_replica_capture_episodes_total{replica_id="1"} 4'
+        in fleet
+    )
+
+    # Train-side: the flywheel gauges are their OWN prefix (the satellite
+    # contract is rt1_flywheel_*, not rt1_train_flywheel_*).
+    fly = prom.render_scalar_gauges(
+        {
+            "shards": 2,
+            "freshness_epoch": 1,
+            "corpus_windows": 36,
+            "corpus_steps": 34,
+            "corpus_episodes": 6,
+            "appended_episodes": 2,
+            "refreshes": 1,
+            "staleness_s": 0.5,
+            "epochs_started": 2,
+        },
+        prefix="rt1_flywheel_",
+    )
+    fly_types, fly_samples = parse_exposition(fly)
+    assert set(fly_types) == {
+        "rt1_flywheel_shards",
+        "rt1_flywheel_freshness_epoch",
+        "rt1_flywheel_corpus_windows",
+        "rt1_flywheel_corpus_steps",
+        "rt1_flywheel_corpus_episodes",
+        "rt1_flywheel_appended_episodes",
+        "rt1_flywheel_refreshes",
+        "rt1_flywheel_staleness_s",
+        "rt1_flywheel_epochs_started",
+    }
+    assert all(t == "gauge" for t in fly_types.values())
+    # The two bodies concatenate into one valid scrape (the train
+    # listener's composition path).
+    combined = prom.render_scalar_gauges({"stall_pct": 1.0}) + fly
+    parse_exposition(combined)
