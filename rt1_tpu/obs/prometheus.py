@@ -159,6 +159,10 @@ _SERVE_COUNTERS = {
         "Sessions re-homed to another replica after theirs died."
     ),
     "batches_total": "Batched device steps executed.",
+    "joined_mid_cycle_total": (
+        "Requests that rode a batch formed while another batch was "
+        "already in flight (continuous-batching occupancy signal)."
+    ),
     # Data-flywheel capture sink (rt1_tpu/flywheel/capture.py) — present
     # only on replicas serving with --capture_dir.
     "capture_episodes_total": "Captured sessions written as episodes.",
@@ -177,6 +181,23 @@ _SERVE_HISTOGRAMS = {
     "latency": ("request_latency_seconds", "Full request wall time."),
     "step": ("step_latency_seconds", "Batched device step latency."),
 }
+
+# snapshot dict keys -> (family, type, help): the per-AOT-bucket occupancy
+# histogram, rendered with a `bucket` label per compiled batch size.
+_SERVE_BUCKET_FAMILIES = (
+    (
+        "bucket_batches",
+        "bucket_batches_total",
+        "counter",
+        "Batched steps executed per AOT batch-size bucket.",
+    ),
+    (
+        "bucket_occupancy_sum",
+        "bucket_occupancy_sum",
+        "counter",
+        "Summed active requests per AOT bucket (mean fill = sum/batches).",
+    ),
+)
 
 
 def render_serve_snapshot(
@@ -219,6 +240,25 @@ def _render_serve_into(
             help_text=help_text,
         )
         consumed.update({f"{key}_buckets", f"{key}_sum_s", f"{key}_count"})
+    # Per-AOT-bucket occupancy histogram (ISSUE 12 continuous batching):
+    # {bucket_size: count} dicts become one labeled family each —
+    # `rt1_serve_bucket_batches_total{bucket="4"} 17`.
+    for key, family, mtype, help_text in _SERVE_BUCKET_FAMILIES:
+        table = snapshot.get(key)
+        if isinstance(table, dict):
+            consumed.add(key)
+            if table:
+                exp.family(
+                    prefix + family,
+                    mtype,
+                    [
+                        ({"bucket": str(b)}, v)
+                        for b, v in sorted(
+                            table.items(), key=lambda kv: int(kv[0])
+                        )
+                    ],
+                    help_text,
+                )
     for key in sorted(snapshot.keys() - consumed):
         value = snapshot[key]
         if isinstance(value, bool) or not isinstance(value, (int, float)):
@@ -251,6 +291,22 @@ _FLEET_REPLICA_FIELDS = {
     "queue_depth": ("gauge", "Micro-batcher queue depth at last batch."),
     "mean_batch_occupancy": ("gauge", "Mean batch fill."),
     "max_batch_occupancy": ("gauge", "Max batch fill."),
+    "batches_in_flight": (
+        "gauge",
+        "Batches dispatched but not yet collected (double-buffer depth).",
+    ),
+    "max_batches_in_flight": (
+        "gauge",
+        "High-water mark of overlapping batches this lifetime.",
+    ),
+    "joined_mid_cycle_total": (
+        "counter",
+        "Requests that rode a batch formed while another was in flight.",
+    ),
+    "bucket_count": (
+        "gauge",
+        "Configured AOT batch-size buckets (compile_count invariant).",
+    ),
     "latency_p50_ms": ("gauge", "Replica-local request p50 (ms)."),
     "latency_p99_ms": ("gauge", "Replica-local request p99 (ms)."),
     "requests_per_sec": ("gauge", "Replica-local request rate."),
@@ -286,6 +342,8 @@ def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
     names = [prefix + "replica_up", prefix + "replica_inference_dtype"]
     for key in _FLEET_REPLICA_FIELDS:
         names.append(prefix + "replica_" + _gauge_suffix(key))
+    for _, family, _, _ in _SERVE_BUCKET_FAMILIES:
+        names.append(prefix + "replica_" + family)
     return names
 
 
@@ -348,6 +406,25 @@ def render_fleet_snapshot(
         exp.family(
             prefix + "replica_" + _gauge_suffix(key), mtype, samples,
             help_text,
+        )
+    # Per-replica AOT-bucket occupancy: two labels (replica_id, bucket)
+    # per sample, so a fleet dashboard can show each replica's fill
+    # profile without scraping replicas individually.
+    for key, family, mtype, help_text in _SERVE_BUCKET_FAMILIES:
+        samples = [
+            ({"replica_id": str(rid), "bucket": str(b)}, v)
+            for rid, snap in sorted(
+                replicas.items(), key=lambda kv: str(kv[0])
+            )
+            if snap is not None and isinstance(snap.get(key), dict)
+            for b, v in sorted(
+                snap[key].items(), key=lambda kv: int(kv[0])
+            )
+        ]
+        if not samples:
+            continue
+        exp.family(
+            prefix + "replica_" + family, mtype, samples, help_text
         )
     return exp.render()
 

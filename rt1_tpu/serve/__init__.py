@@ -2,9 +2,13 @@
 
 The train→eval→serve third leg (docs/serving.md): `PolicyEngine` holds many
 sessions' rolling network state as slots of one donated device batch and
-steps them in a single AOT-compiled call (params are a swappable input —
-`swap_variables` hot-swaps checkpoints with zero downtime); `MicroBatcher`
-coalesces concurrent requests under a latency deadline with bounded-queue
+steps them through a pinned set of AOT-compiled batch-size buckets (params
+are a swappable input — `swap_variables` hot-swaps checkpoints with zero
+downtime; `dispatch_batch`/`collect_batch` split the step for the
+double-buffered device pipeline); `ContinuousBatcher` rolls requests into
+the next device step the moment they land with up to `pipeline_depth`
+batches in flight, while `MicroBatcher` keeps the legacy
+deadline-or-full cycle for A/B baselines — both with bounded-queue
 backpressure; `server.py` exposes the stdlib HTTP frontend
 (`python -m rt1_tpu.serve`); `metrics.py` tracks latency/occupancy/
 throughput in `trainer/metrics.py` writer conventions.
@@ -17,8 +21,18 @@ supervises the replica processes with deterministic chaos injection from
 the fleet tests and accelerator-less rehearsals run against.
 """
 
-from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
-from rt1_tpu.serve.engine import PolicyEngine, SessionError
+from rt1_tpu.serve.batcher import (
+    BusyError,
+    ContinuousBatcher,
+    DrainingError,
+    MicroBatcher,
+)
+from rt1_tpu.serve.engine import (
+    PolicyEngine,
+    SessionError,
+    SlotContentionError,
+    pow2_buckets,
+)
 from rt1_tpu.serve.metrics import LatencyHistogram, ServeMetrics
 from rt1_tpu.serve.router import Replica, Router, make_router_server
 from rt1_tpu.serve.server import (
@@ -31,10 +45,13 @@ from rt1_tpu.serve.server import (
 
 __all__ = [
     "BusyError",
+    "ContinuousBatcher",
     "DrainingError",
     "MicroBatcher",
     "PolicyEngine",
     "SessionError",
+    "SlotContentionError",
+    "pow2_buckets",
     "LatencyHistogram",
     "ServeMetrics",
     "Replica",

@@ -102,12 +102,21 @@ def main(argv):
             "--allow_embedder_mismatch to override",
             manifest_name="data_manifest.json",
         )
+    from rt1_tpu.serve.engine import pow2_buckets
+
+    if FLAGS.buckets.strip() == "auto":
+        buckets = pow2_buckets(FLAGS.max_sessions)
+    else:
+        buckets = [
+            int(b) for b in FLAGS.buckets.split(",") if b.strip()
+        ] or None
     embedder = get_embedder(FLAGS.embedder)
     engine, step = build_serve_engine(
         config,
         workdir=None if FLAGS.random_init else FLAGS.workdir,
         inference_dtype=FLAGS.inference_dtype,
         max_sessions=FLAGS.max_sessions,
+        buckets=buckets,
         embedder=embedder,
     )
 
@@ -145,6 +154,8 @@ def main(argv):
         max_batch=FLAGS.max_batch or None,
         max_delay_s=FLAGS.max_delay_ms / 1e3,
         max_queue=FLAGS.max_queue,
+        scheduler=FLAGS.scheduler,
+        pipeline_depth=FLAGS.pipeline_depth,
         request_timeout_s=FLAGS.request_timeout_s,
         replica_id=FLAGS.replica_id,
         reload_fn=reload_fn,
@@ -170,6 +181,8 @@ def main(argv):
                 "checkpoint_step": step,
                 "max_sessions": engine.max_sessions,
                 "compile_count": engine.compile_count,
+                "buckets": [int(b) for b in engine.buckets],
+                "scheduler": FLAGS.scheduler,
                 "inference_dtype": engine.inference_dtype,
                 "param_bytes_device": engine.serving_param_bytes,
             }
@@ -208,10 +221,28 @@ if __name__ == "__main__":
         "Micro-batch flush size (0 = max_sessions).")
     flags.DEFINE_float(
         "max_delay_ms", 10.0,
-        "Micro-batching deadline: longest a request waits for batchmates.")
+        "[cycle scheduler] Micro-batching deadline: longest a request "
+        "waits for batchmates. The continuous scheduler never waits — "
+        "batching emerges from device busy time.")
     flags.DEFINE_integer(
         "max_queue", 64,
         "Bounded admission queue; beyond this /act returns 503 busy.")
+    flags.DEFINE_enum(
+        "scheduler", "continuous", ["continuous", "cycle"],
+        "Batch scheduler: 'continuous' rolls requests into the next "
+        "device step the moment they land (double-buffered pipeline); "
+        "'cycle' is the legacy wait-for-deadline-or-full loop (A/B "
+        "baseline).")
+    flags.DEFINE_integer(
+        "pipeline_depth", 2,
+        "[continuous] Max batches in flight: 2 = prepare/upload batch "
+        "N+1 while N executes (double buffering).")
+    flags.DEFINE_string(
+        "buckets", "auto",
+        "AOT batch-size buckets, comma-separated (e.g. '1,2,4,8'); "
+        "'auto' = powers of two up to max_sessions. Every bucket is "
+        "compiled at warm-up; compile_count is pinned at the bucket "
+        "count for the process lifetime.")
     flags.DEFINE_float(
         "request_timeout_s", 60.0, "Server-side per-request timeout.")
     flags.DEFINE_integer(

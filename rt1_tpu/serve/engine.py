@@ -9,13 +9,26 @@ the engine state carries a leading slot axis (`seq_idx` becomes `(N,)`),
 each session owns one slot, and sessions at different points of their
 episode coexist in one device batch.
 
-Fixed shapes, one compile: the batch is always padded to `max_sessions`
-with an `active` mask; inactive slots compute garbage that is discarded and
-their state is `where`-gated back to its previous value. The step is
-lowered and compiled **ahead of time** (`jax.jit(...).lower(...).compile()`)
-so exactly one XLA compilation of the batched step ever happens — a later
+Fixed shapes, pinned compiles: the engine compiles a small set of
+**batch-size buckets** (config-driven, default just `[max_sessions]`) and
+every batch rides the smallest bucket that fits, so light traffic stops
+paying the full-batch step cost. Each bucket executable gathers its lanes'
+rows out of the full `(max_sessions, ...)` state tree by slot index, steps
+them, and scatters the (active-gated) results back — padding lanes ride
+distinct unused slots and write their old value back, so no batch
+composition can corrupt a neighbour. Every bucket is lowered and compiled
+**ahead of time** (`jax.jit(...).lower(...).compile()`), `compile_count`
+is pinned at exactly `len(buckets)` for the engine lifetime, and a later
 shape mismatch is a hard error, not a silent recompile. The state argument
 is donated: the rolling window updates in place on device, no per-step copy.
+
+The hot path is split into `dispatch_batch` (host work + async device
+dispatch, under the lock) and `collect_batch` (the blocking device→host
+fetch, outside the lock), so a serving frontend can **double-buffer**:
+prepare and dispatch batch N+1 while batch N still executes — XLA orders
+the two steps through the donated state dependency, and sessions riding an
+in-flight step are protected from LRU eviction until their results land.
+`act_batch` remains the dispatch-then-collect composition.
 
 The model parameters are an **argument** of the compiled step, not a
 closure capture — a captured array would be baked into the executable as a
@@ -25,8 +38,8 @@ hot-swap a newly restored checkpoint between two batches: validate the new
 tree in a standby host buffer (structure, shapes, dtypes, finiteness),
 transfer it to the device off the request path, then atomically repoint
 the engine under the lock. In-flight batches finish on the old params, the
-next batch runs on the new ones, and the single-compile invariant holds
-across any number of reloads.
+next batch runs on the new ones, and the pinned-compile invariant
+(`compile_count == len(buckets)`) holds across any number of reloads.
 
 Host-side the engine adds the serving conveniences the eval policy never
 needed: session→slot assignment with LRU reclaim, per-slot reset, action
@@ -54,6 +67,63 @@ class SessionError(RuntimeError):
     """Invalid session usage (duplicate id in one batch, unknown release)."""
 
 
+class SlotContentionError(SessionError):
+    """No slot can be reclaimed for a new session right now — every slot
+    belongs to this batch or to a step still in flight. Transient under
+    double-buffered oversubscription; the HTTP layer maps it to a
+    retryable 503 (busy), never a hard failure."""
+
+
+def pow2_buckets(max_sessions: int) -> List[int]:
+    """The default AOT bucket ladder: powers of two up to (and always
+    including) `max_sessions` — e.g. 8 -> [1, 2, 4, 8], 6 -> [1, 2, 4, 6]."""
+    out = []
+    b = 1
+    while b < max_sessions:
+        out.append(b)
+        b *= 2
+    out.append(max_sessions)
+    return out
+
+
+def normalize_buckets(buckets, max_sessions: int) -> Tuple[int, ...]:
+    """Validate/canonicalize a bucket list: sorted, unique, within
+    [1, max_sessions], and always topped by `max_sessions` so every legal
+    batch has a bucket to ride."""
+    if buckets is None:
+        return (max_sessions,)
+    out = sorted({int(b) for b in buckets})
+    if not out or out[0] < 1 or out[-1] > max_sessions:
+        raise ValueError(
+            f"buckets {list(buckets)} must be within [1, {max_sessions}]"
+        )
+    if out[-1] != max_sessions:
+        out.append(max_sessions)
+    return tuple(out)
+
+
+class StepHandle:
+    """One in-flight batched step: everything `collect_batch` needs to
+    turn the (possibly still executing) device output into per-item
+    results. Created by `dispatch_batch`; single-use."""
+
+    __slots__ = (
+        "items", "errors", "slots_by_sid", "lane_by_sid", "fresh",
+        "bucket", "active_count", "out", "collected",
+    )
+
+    def __init__(self, items):
+        self.items = list(items)
+        self.errors: List[Optional[Exception]] = [None] * len(self.items)
+        self.slots_by_sid: Dict[str, int] = {}
+        self.lane_by_sid: Dict[str, int] = {}
+        self.fresh: set = set()
+        self.bucket: Optional[int] = None  # None: nothing was dispatched
+        self.active_count = 0
+        self.out = None
+        self.collected = False
+
+
 class PolicyEngine:
     """Holds N session slots of rolling network state in one device batch."""
 
@@ -71,6 +141,7 @@ class PolicyEngine:
         embed_cache_size: int = 256,
         tokenizer=None,
         plan=None,
+        buckets: Optional[Sequence[int]] = None,
         inference_dtype: str = "f32",
         prepare_variables: Optional[Callable[[Any], Any]] = None,
         master_variables=None,
@@ -80,6 +151,10 @@ class PolicyEngine:
 
         if max_sessions < 1:
             raise ValueError(f"max_sessions must be >= 1, got {max_sessions}")
+        # AOT batch-size buckets: a batch of k active items rides the
+        # smallest bucket >= k. Default is the single full-size bucket —
+        # the pre-bucket padding semantics, one compile.
+        self.buckets = normalize_buckets(buckets, max_sessions)
         self._jax = jax
         self._model = model
         self._plan = plan
@@ -156,10 +231,16 @@ class PolicyEngine:
         self._sessions: collections.OrderedDict = collections.OrderedDict()
         self._free: List[int] = list(range(max_sessions))
         self.evictions = 0  # LRU slot reclaims (oversubscription signal)
+        # Sessions riding a dispatched-but-uncollected step: protected
+        # from LRU eviction so a double-buffered frontend can never zero
+        # a slot whose result is still on the wire.
+        self._inflight_sessions: collections.Counter = collections.Counter()
+        self.batches_in_flight = 0  # dispatched, not yet collected
 
-        # AOT compilation happens lazily at the first act (or explicit
-        # warmup()) because only then are H, W and the embedding dim known.
-        self._compiled = None
+        # AOT compilation of EVERY bucket happens lazily at the first act
+        # (or explicit warmup()) because only then are H, W and the
+        # embedding dim known. compile_count is pinned at len(buckets).
+        self._compiled: Dict[int, Any] = {}
         self._compiled_obs_shapes: Optional[Dict[str, Tuple]] = None
         self.compile_count = 0
         self.reloads = 0  # successful swap_variables hot-swaps
@@ -203,15 +284,29 @@ class PolicyEngine:
 
     # ------------------------------------------------------------ compile
 
+    def bucket_for(self, active: int) -> int:
+        """Deterministic bucket selection: the smallest configured bucket
+        that fits `active` items (monotone in `active`)."""
+        if active < 1 or active > self.max_sessions:
+            raise ValueError(
+                f"active={active} outside [1, {self.max_sessions}]"
+            )
+        for b in self.buckets:
+            if b >= active:
+                return b
+        return self.buckets[-1]  # unreachable: buckets top at max_sessions
+
     def _build_step(self, obs_shapes: Dict[str, Tuple[int, ...]]):
-        """Lower + compile the batched step for fixed per-item obs shapes."""
+        """Lower + compile the batched step for EVERY bucket at fixed
+        per-item obs shapes — compile_count lands at len(buckets) and
+        never moves again."""
         import jax
         import jax.numpy as jnp
 
         model = self._model
 
         def single_step(variables, obs, state):
-            # One slot == one batch-1 infer_step; vmap gives each lane its
+            # One lane == one batch-1 infer_step; vmap gives each lane its
             # own scalar seq_idx (per-slot roll phase), which the batched
             # state pytree cannot express directly.
             obs_b = {k: v[None] for k, v in obs.items()}
@@ -231,11 +326,16 @@ class PolicyEngine:
             }
             return out, new_state
 
-        def batched_step(variables, obs, active, state):
-            # Params are an argument (broadcast over slots, NOT donated) so
+        def bucket_step(variables, obs, slots, active, state):
+            # Params are an argument (broadcast over lanes, NOT donated) so
             # swap_variables can hand the same executable a new checkpoint.
+            # `slots` are host-guaranteed DISTINCT rows of the full state
+            # tree (padding lanes ride unused slots), so gather → step →
+            # scatter is race-free and the donated full state updates in
+            # place.
+            lanes = jax.tree.map(lambda x: x[slots], state)
             out, stepped = jax.vmap(single_step, in_axes=(None, 0, 0))(
-                variables, obs, state
+                variables, obs, lanes
             )
 
             def gate(new, old):
@@ -244,12 +344,14 @@ class PolicyEngine:
                 )
                 return jnp.where(mask, new, old)
 
-            # Inactive slots ran on padding; their rolling state must not
-            # advance. Gating inside the compiled step keeps the whole
-            # update a single donated in-place device program.
-            return out, jax.tree.map(gate, stepped, state)
+            # Padding lanes ran on garbage; gate their old row back before
+            # the scatter so their slots' rolling state does not advance.
+            gated = jax.tree.map(gate, stepped, lanes)
+            new_state = jax.tree.map(
+                lambda full, rows: full.at[slots].set(rows), state, gated
+            )
+            return out, new_state
 
-        n = self.max_sessions
         # With a plan the lowered step carries each argument's mesh
         # placement, so XLA partitions the batched step (GSPMD) instead of
         # assuming one default device; without one the specs are placement-
@@ -264,31 +366,34 @@ class PolicyEngine:
             )
 
         var_spec = jax.tree.map(spec_of, self._variables)
-        obs_spec = {
-            k: jax.ShapeDtypeStruct(
-                (n,) + tuple(shape), np.float32, sharding=repl
-            )
-            for k, shape in obs_shapes.items()
-        }
-        active_spec = jax.ShapeDtypeStruct((n,), np.bool_, sharding=repl)
         state_spec = jax.tree.map(spec_of, self._state)
-        lowered = jax.jit(batched_step, donate_argnums=(3,)).lower(
-            var_spec, obs_spec, active_spec, state_spec
-        )
-        self._compiled = lowered.compile()
+        for b in self.buckets:
+            obs_spec = {
+                k: jax.ShapeDtypeStruct(
+                    (b,) + tuple(shape), np.float32, sharding=repl
+                )
+                for k, shape in obs_shapes.items()
+            }
+            slots_spec = jax.ShapeDtypeStruct((b,), np.int32, sharding=repl)
+            active_spec = jax.ShapeDtypeStruct((b,), np.bool_, sharding=repl)
+            lowered = jax.jit(bucket_step, donate_argnums=(4,)).lower(
+                var_spec, obs_spec, slots_spec, active_spec, state_spec
+            )
+            self._compiled[b] = lowered.compile()
+            self.compile_count += 1
         self._compiled_obs_shapes = dict(obs_shapes)
-        self.compile_count += 1
 
     def warmup(
         self,
         image_shape: Sequence[int],
         embed_dim: int = EMBEDDING_DIM,
     ) -> None:
-        """AOT-compile the batched step before traffic arrives.
+        """AOT-compile every configured bucket before traffic arrives —
+        no live request ever pays an XLA compile.
 
         `image_shape` is the per-item (H, W, 3); pair with
         `compilation_cache.enable_persistent_cache()` at process startup so
-        even the single compile is served from disk on restarts.
+        even the pinned compiles are served from disk on restarts.
         """
         with self._lock:
             self._ensure_compiled(
@@ -299,7 +404,7 @@ class PolicyEngine:
             )
 
     def _ensure_compiled(self, obs_shapes: Dict[str, Tuple[int, ...]]):
-        if self._compiled is None:
+        if not self._compiled:
             self._build_step(obs_shapes)
         elif self._compiled_obs_shapes != obs_shapes:
             raise ValueError(
@@ -340,7 +445,7 @@ class PolicyEngine:
         never stalled; only the final pointer swap takes the lock. Because
         the params are an undonated input of the AOT-compiled executable —
         identical shapes/dtypes are enforced here — no recompile can
-        occur: the single-compile invariant survives any number of
+        occur: the pinned-compile invariant survives any number of
         reloads. Raises ValueError (engine untouched, old params keep
         serving) on a structure/shape/dtype mismatch or a non-finite leaf.
 
@@ -422,7 +527,7 @@ class PolicyEngine:
                     f"swap_variables: prepared serving leaf {path!r} is "
                     f"{tuple(new.shape)}/{new.dtype}, compiled "
                     f"{tuple(old.shape)}/{old.dtype} — rejected to keep "
-                    "the single-compile invariant"
+                    "the pinned-compile invariant"
                 )
         # Rebuild on the SERVING treedef (a restored checkpoint may arrive
         # as plain dicts while the engine was built from a FrozenDict —
@@ -465,13 +570,17 @@ class PolicyEngine:
             # Reclaim the least-recently-used session's slot. The evicted
             # session is forgotten; if it comes back it starts a fresh
             # window (clients idle past the slot budget should /reset).
-            # `protected` holds the current batch's session ids — a session
-            # being stepped right now must never be the eviction victim.
-            victim = next(iter(self._sessions))
-            if victim in protected:
-                raise SessionError(
+            # `protected` holds the current batch's session ids plus every
+            # session riding a still-in-flight step — a session being
+            # stepped right now must never be the eviction victim.
+            victim = next(
+                (s for s in self._sessions if s not in protected), None
+            )
+            if victim is None:
+                raise SlotContentionError(
                     f"no reclaimable slot for session {session_id!r}: all "
-                    f"{self.max_sessions} slots belong to this batch"
+                    f"{self.max_sessions} slots belong to this batch or an "
+                    "in-flight step; retry after the step completes"
                 )
             slot = self._sessions.pop(victim)
             self.evictions += 1
@@ -485,9 +594,14 @@ class PolicyEngine:
         )
 
     def reset(self, session_id: str) -> int:
-        """Zero a session's rolling window (allocating a slot if new)."""
+        """Zero a session's rolling window (allocating a slot if new).
+        A new session's slot claim honors the same in-flight protection
+        as /act: it must not evict a session riding a dispatched-but-
+        uncollected step (retryable SlotContentionError instead)."""
         with self._lock:
-            slot = self._slot_for(session_id)
+            slot = self._slot_for(
+                session_id, protected=frozenset(self._inflight_sessions)
+            )
             self._zero_slot(slot)
             return slot
 
@@ -532,28 +646,28 @@ class PolicyEngine:
             embedding = self._embed_instruction(obs["instruction"])
         return {"image": image, "natural_language_embedding": embedding}
 
-    def act_batch(
+    def dispatch_batch(
         self, items: Sequence[Tuple[str, Dict[str, Any]]]
-    ) -> List[Dict[str, Any]]:
-        """Run one batched control step for `items` = [(session_id, obs)].
+    ) -> StepHandle:
+        """Phase 1 of a batched control step: resolve observations, assign
+        slots, and **asynchronously dispatch** the smallest bucket that
+        fits. Returns a `StepHandle` the caller hands to `collect_batch`.
 
-        Each obs carries `image` (H, W, 3) float32 in [0, 1] plus either
-        `natural_language_embedding` (D,) or `instruction` (str). Returns
-        one dict per item: the de-normalized, clipped `action` and the raw
-        `action_tokens` — or `{"error": ...}` for an item whose observation
-        failed to resolve/validate (a bad request must not poison its
-        batchmates; its session state does not advance). Session ids must
-        be unique within one batch (the batcher's `batch_key` guarantees it
-        in the serving path).
+        The device may still be executing when this returns — that is the
+        point: a double-buffering caller dispatches batch N+1 while batch
+        N's collect blocks, and XLA serializes the two steps through the
+        donated state dependency. Sessions riding this handle are
+        protected from LRU eviction until collected.
         """
-        if not items:
-            return []
-        if len(items) > self.max_sessions:
+        handle = StepHandle(items)
+        if not handle.items:
+            return handle
+        if len(handle.items) > self.max_sessions:
             raise SessionError(
-                f"batch of {len(items)} exceeds max_sessions="
+                f"batch of {len(handle.items)} exceeds max_sessions="
                 f"{self.max_sessions}"
             )
-        ids = [sid for sid, _ in items]
+        ids = [sid for sid, _ in handle.items]
         if len(set(ids)) != len(ids):
             raise SessionError(
                 f"duplicate session ids in one batch: {ids} — a "
@@ -565,125 +679,188 @@ class PolicyEngine:
         # (/healthz, /metrics) must not stall behind it. Per-item failures
         # become per-item error results, not a poisoned batch.
         resolved: List[Optional[Dict[str, np.ndarray]]] = []
-        errors: List[Optional[Exception]] = []
-        # obs: nested inside the server's device_step span — an embedder
-        # cache miss (full text-tower forward) shows up as engine_resolve
-        # dwarfing engine_dispatch, instead of being booked as device time.
-        with obs_trace.span("engine_resolve", batch=len(items)):
-            for sid, obs in items:
+        # obs: an embedder cache miss (full text-tower forward) shows up
+        # as engine_resolve dwarfing engine_dispatch, instead of being
+        # booked as device time.
+        with obs_trace.span("engine_resolve", batch=len(handle.items)):
+            for i, (sid, obs) in enumerate(handle.items):
                 try:
                     resolved.append(self._resolve_obs(obs))
-                    errors.append(None)
                 except Exception as exc:  # noqa: BLE001 - isolated per item
                     resolved.append(None)
-                    errors.append(exc)
+                    handle.errors[i] = exc
 
         good = [
             (i, sid, obs)
-            for i, ((sid, _), obs) in enumerate(zip(items, resolved))
+            for i, ((sid, _), obs) in enumerate(zip(handle.items, resolved))
             if obs is not None
         ]
-        slots_by_sid: Dict[str, int] = {}
-        fresh: set = set()
-        if good:
-            with self._lock:
-                # First use compiles (shapes come from the first item);
-                # afterwards mismatches are handled per item below.
-                if self._compiled is None:
-                    self._build_step(
-                        {k: v.shape for k, v in good[0][2].items()}
-                    )
+        if not good:
+            return handle
+        with self._lock:
+            # First use compiles every bucket (shapes come from the first
+            # item); afterwards mismatches are handled per item below.
+            if not self._compiled:
+                self._build_step({k: v.shape for k, v in good[0][2].items()})
 
-                # Per-item shape check BEFORE any slot is assigned: a
-                # mismatched item becomes its own error result instead of
-                # poisoning the batch (and allocates no slot).
-                kept = []
-                for i, sid, obs in good:
-                    bad_key = next(
-                        (
-                            k
-                            for k, v in obs.items()
-                            if v.shape != self._compiled_obs_shapes[k]
-                        ),
-                        None,
-                    )
-                    if bad_key is not None:
-                        errors[i] = ValueError(
-                            f"session {sid!r} obs {bad_key!r} shape "
-                            f"{obs[bad_key].shape} != compiled "
-                            f"{self._compiled_obs_shapes[bad_key]}"
-                        )
-                    else:
-                        kept.append((sid, obs))
-
-                # Two-pass slot assignment: touch every EXISTING batch
-                # session first (marking it most-recently-used) so a new
-                # session's LRU reclaim can never evict a batchmate whose
-                # step is in flight. `fresh` marks sessions starting a new
-                # (zeroed) window this step — surfaced in the result so a
-                # client whose session was LRU-evicted can detect the
-                # silent context reset instead of acting on it unaware.
-                fresh.update(
-                    sid for sid, _ in kept if sid not in self._sessions
+            # Per-item shape check BEFORE any slot is assigned: a
+            # mismatched item becomes its own error result instead of
+            # poisoning the batch (and allocates no slot).
+            kept = []
+            for i, sid, obs in good:
+                bad_key = next(
+                    (
+                        k
+                        for k, v in obs.items()
+                        if v.shape != self._compiled_obs_shapes[k]
+                    ),
+                    None,
                 )
-                batch_ids = frozenset(sid for sid, _ in kept)
-                for sid, _ in kept:
+                if bad_key is not None:
+                    handle.errors[i] = ValueError(
+                        f"session {sid!r} obs {bad_key!r} shape "
+                        f"{obs[bad_key].shape} != compiled "
+                        f"{self._compiled_obs_shapes[bad_key]}"
+                    )
+                else:
+                    kept.append((i, sid, obs))
+
+            # Slot assignment in one pass; eviction safety comes from the
+            # `protected` set (every batchmate's id plus every session
+            # riding a still-in-flight step), NOT from assignment order —
+            # a newcomer's LRU reclaim skips protected sessions and fails
+            # with a retryable SlotContentionError when none is left.
+            # `fresh` marks sessions starting a new (zeroed) window this
+            # step — surfaced in the result so a client whose session was
+            # LRU-evicted can detect the silent context reset instead of
+            # acting on it unaware.
+            handle.fresh.update(
+                sid for _, sid, _ in kept if sid not in self._sessions
+            )
+            batch_ids = frozenset(sid for _, sid, _ in kept)
+            protected = batch_ids | frozenset(self._inflight_sessions)
+            for idx, sid, _ in list(kept):
+                try:
                     if sid in self._sessions:
-                        slots_by_sid[sid] = self._slot_for(sid)
-                for sid, _ in kept:
-                    if sid not in slots_by_sid:
-                        slots_by_sid[sid] = self._slot_for(
-                            sid, protected=batch_ids
+                        handle.slots_by_sid[sid] = self._slot_for(sid)
+                    else:
+                        handle.slots_by_sid[sid] = self._slot_for(
+                            sid, protected=protected
                         )
+                except SlotContentionError as exc:
+                    # Transient: every slot is riding this batch or an
+                    # in-flight step. Fail THIS item retryably (503 busy
+                    # upstream); its batchmates still step.
+                    handle.errors[idx] = exc
+                    handle.fresh.discard(sid)
+                    kept = [k for k in kept if k[1] != sid]
 
-                if kept:
-                    n = self.max_sessions
-                    batch_obs = {
-                        k: np.zeros((n,) + tuple(shape), np.float32)
-                        for k, shape in self._compiled_obs_shapes.items()
-                    }
-                    active = np.zeros((n,), np.bool_)
-                    for sid, obs in kept:
-                        slot = slots_by_sid[sid]
-                        for k, v in obs.items():
-                            batch_obs[k][slot] = v
-                        active[slot] = True
+            if not kept:
+                return handle
+            bucket = self.bucket_for(len(kept))
+            batch_obs = {
+                k: np.zeros((bucket,) + tuple(shape), np.float32)
+                for k, shape in self._compiled_obs_shapes.items()
+            }
+            active = np.zeros((bucket,), np.bool_)
+            slots = np.zeros((bucket,), np.int32)
+            for lane, (_, sid, obs) in enumerate(kept):
+                handle.lane_by_sid[sid] = lane
+                slots[lane] = handle.slots_by_sid[sid]
+                for k, v in obs.items():
+                    batch_obs[k][lane] = v
+                active[lane] = True
+            # Padding lanes ride DISTINCT unused slots (there are always
+            # enough: bucket <= max_sessions) and scatter their old row
+            # back — a no-op write, so duplicate-index scatter hazards
+            # cannot exist by construction.
+            used = set(int(s) for s in slots[: len(kept)])
+            pads = [s for s in range(self.max_sessions) if s not in used]
+            for lane in range(len(kept), bucket):
+                slots[lane] = pads[lane - len(kept)]
 
-                    # obs: dispatch + the blocking device→host fetch of
-                    # the outputs (jax dispatch is async; np.asarray is
-                    # where the wall time of the XLA step actually lands).
-                    with obs_trace.span(
-                        "engine_dispatch", active=len(kept)
-                    ):
-                        out, self._state = self._compiled(
-                            self._variables, batch_obs, active, self._state
-                        )
+            # obs: async dispatch only — the blocking device→host fetch
+            # lands in collect_batch's engine_fetch span, making the
+            # double-buffer overlap visible on the trace timeline.
+            with obs_trace.span(
+                "engine_dispatch", active=len(kept), bucket=bucket
+            ):
+                handle.out, self._state = self._compiled[bucket](
+                    self._variables, batch_obs, slots, active, self._state
+                )
+            handle.bucket = bucket
+            handle.active_count = len(kept)
+            for _, sid, _ in kept:
+                self._inflight_sessions[sid] += 1
+            self.batches_in_flight += 1
+        return handle
 
-                        actions = np.asarray(out["action"])
-                        tokens = np.asarray(out["action_tokens"])
+    def collect_batch(self, handle: StepHandle) -> List[Dict[str, Any]]:
+        """Phase 2: block on the handle's device step (outside the lock)
+        and build one result dict per item — the de-normalized, clipped
+        `action` and the raw `action_tokens`, or `{"error": ...}` for an
+        item whose observation failed to resolve/validate (a bad request
+        must not poison its batchmates; its session state does not
+        advance)."""
+        if handle.collected:
+            raise RuntimeError("StepHandle already collected")
+        handle.collected = True
+        actions = tokens = terminate = None
+        if handle.out is not None:
+            try:
+                # obs: the blocking fetch — under double-buffering this
+                # span overlaps the NEXT batch's engine_dispatch.
+                with obs_trace.span(
+                    "engine_fetch", active=handle.active_count,
+                    bucket=handle.bucket,
+                ):
+                    actions = np.asarray(handle.out["action"])
+                    tokens = np.asarray(handle.out["action_tokens"])
                     terminate = (
-                        np.asarray(out["terminate_episode"])
-                        if "terminate_episode" in out
+                        np.asarray(handle.out["terminate_episode"])
+                        if "terminate_episode" in handle.out
                         else None
                     )
+            finally:
+                # ALWAYS release the eviction protection, even when the
+                # fetch itself fails (device fault mid-step): a leaked
+                # in-flight count would permanently pin its sessions'
+                # slots and starve every future newcomer.
+                with self._lock:
+                    for sid in handle.lane_by_sid:
+                        self._inflight_sessions[sid] -= 1
+                        if self._inflight_sessions[sid] <= 0:
+                            del self._inflight_sessions[sid]
+                    self.batches_in_flight -= 1
 
         results: List[Dict[str, Any]] = []
-        for (sid, _), error in zip(items, errors):
+        for (sid, _), error in zip(handle.items, handle.errors):
             if error is not None:
                 results.append({"error": error})
                 continue
-            slot = slots_by_sid[sid]
-            action = actions[slot] * max(self.action_std, EPS) + self.action_mean
+            lane = handle.lane_by_sid[sid]
+            action = actions[lane] * max(self.action_std, EPS) + self.action_mean
             action = np.clip(action, self.action_minimum, self.action_maximum)
             result = {
                 "action": action.astype(np.float32),
-                "action_tokens": tokens[slot],
-                "session_started": sid in fresh,
+                "action_tokens": tokens[lane],
+                "session_started": sid in handle.fresh,
             }
             if terminate is not None:
-                result["terminate_episode"] = int(terminate[slot])
+                result["terminate_episode"] = int(terminate[lane])
             results.append(result)
         return results
+
+    def act_batch(
+        self, items: Sequence[Tuple[str, Dict[str, Any]]]
+    ) -> List[Dict[str, Any]]:
+        """Run one batched control step for `items` = [(session_id, obs)]:
+        `dispatch_batch` then `collect_batch`, back to back. Each obs
+        carries `image` (H, W, 3) float32 in [0, 1] plus either
+        `natural_language_embedding` (D,) or `instruction` (str). Session
+        ids must be unique within one batch (the batcher's `batch_key`
+        guarantees it in the serving path)."""
+        return self.collect_batch(self.dispatch_batch(items))
 
     def act(self, session_id: str, obs: Dict[str, Any]) -> Dict[str, Any]:
         """Single-session convenience wrapper over `act_batch`; re-raises

@@ -518,6 +518,8 @@ def replica_dtype_for(args, replica_id: int) -> str:
 def replica_argv_builder(args) -> Callable[[int], List[str]]:
     """argv factory for one replica — the stub or the real server."""
     slow_threshold = getattr(args, "slow_threshold_ms", 0.0)
+    scheduler = getattr(args, "scheduler", "continuous")
+    buckets = getattr(args, "buckets", "auto")
     if args.stub:
         def build(replica_id: int) -> List[str]:
             return [
@@ -528,6 +530,10 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
                 "--act_delay_s", str(args.stub_act_delay_s),
                 "--slow_threshold_ms", str(slow_threshold),
                 "--inference_dtype", replica_dtype_for(args, replica_id),
+                "--scheduler", scheduler,
+                # The stub has no compiler; it advertises the contract
+                # field ("1" = one bucket) unless a ladder is forced.
+                "--buckets", buckets if buckets != "auto" else "1",
             ]
         return build
 
@@ -543,6 +549,8 @@ def replica_argv_builder(args) -> Callable[[int], List[str]]:
             "--embedder", args.embedder,
             "--slow_threshold_ms", str(slow_threshold),
             "--inference_dtype", replica_dtype_for(args, replica_id),
+            "--scheduler", scheduler,
+            "--buckets", buckets,
         ]
         if capture_root:
             # Per-replica capture dir; the supervisor sweeps completed
@@ -582,6 +590,16 @@ def main(argv=None) -> int:
     parser.add_argument("--max_sessions", type=int, default=8)
     parser.add_argument("--embedder", default="hash")
     parser.add_argument("--stub_act_delay_s", type=float, default=0.0)
+    parser.add_argument(
+        "--scheduler", default="continuous",
+        choices=["continuous", "cycle"],
+        help="Batch scheduler forwarded to every replica (ISSUE 12: "
+             "'continuous' rolls requests into the next device step; "
+             "'cycle' is the legacy deadline loop).")
+    parser.add_argument(
+        "--buckets", default="auto",
+        help="AOT batch-size buckets forwarded to every replica "
+             "('auto' = pow2 ladder; comma ints to pin).")
     parser.add_argument(
         "--inference_dtype", default="f32",
         choices=["f32", "bf16", "int8"],

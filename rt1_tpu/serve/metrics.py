@@ -86,6 +86,16 @@ class ServeMetrics:
         self.occupancy_sum = 0
         self.occupancy_max = 0
         self.queue_depth = 0
+        # Continuous-batching / double-buffer path (ISSUE 12): batches
+        # currently dispatched-but-uncollected, requests that joined a
+        # batch formed while another was already in flight, and the
+        # per-bucket occupancy histogram (bucket size -> batch count +
+        # summed occupancy, so mean fill per bucket is derivable).
+        self.batches_in_flight = 0
+        self.max_batches_in_flight = 0
+        self.joined_mid_cycle_total = 0
+        self.bucket_batches: Dict[int, int] = {}
+        self.bucket_occupancy_sum: Dict[int, int] = {}
         self.latency = LatencyHistogram()      # full request wall time
         self.step_latency = LatencyHistogram()  # batched device step only
 
@@ -116,12 +126,44 @@ class ServeMetrics:
         with self._lock:
             self.sessions_restarted_total += 1
 
-    def observe_batch(self, size: int, queued: int = 0) -> None:
+    def observe_batch(
+        self,
+        size: int,
+        queued: int = 0,
+        in_flight: int = 0,
+        joined_mid_cycle: int = 0,
+    ) -> None:
         with self._lock:
             self.batches_total += 1
             self.occupancy_sum += size
             self.occupancy_max = max(self.occupancy_max, size)
             self.queue_depth = queued
+            self.batches_in_flight = in_flight
+            self.max_batches_in_flight = max(
+                self.max_batches_in_flight, in_flight
+            )
+            self.joined_mid_cycle_total += joined_mid_cycle
+
+    def observe_inflight(self, in_flight: int) -> None:
+        """A batch completed (or launched outside observe_batch): refresh
+        the in-flight gauge."""
+        with self._lock:
+            self.batches_in_flight = in_flight
+            self.max_batches_in_flight = max(
+                self.max_batches_in_flight, in_flight
+            )
+
+    def observe_bucket(self, bucket: int, occupancy: int) -> None:
+        """One batch rode the AOT bucket of size `bucket` carrying
+        `occupancy` active requests (the per-bucket occupancy histogram)."""
+        with self._lock:
+            self.bucket_batches[int(bucket)] = (
+                self.bucket_batches.get(int(bucket), 0) + 1
+            )
+            self.bucket_occupancy_sum[int(bucket)] = (
+                self.bucket_occupancy_sum.get(int(bucket), 0)
+                + int(occupancy)
+            )
 
     def observe_step(self, seconds: float) -> None:
         with self._lock:
@@ -208,6 +250,19 @@ class ServeMetrics:
                 ),
                 "max_batch_occupancy": self.occupancy_max,
                 "queue_depth": self.queue_depth,
+                "batches_in_flight": self.batches_in_flight,
+                "max_batches_in_flight": self.max_batches_in_flight,
+                "joined_mid_cycle_total": self.joined_mid_cycle_total,
+                # Per-bucket occupancy histogram, string-keyed for JSON;
+                # the Prometheus renderer turns these into labeled
+                # `rt1_serve_bucket_*{bucket="N"}` families.
+                "bucket_batches": {
+                    str(k): v for k, v in sorted(self.bucket_batches.items())
+                },
+                "bucket_occupancy_sum": {
+                    str(k): v
+                    for k, v in sorted(self.bucket_occupancy_sum.items())
+                },
             }
             out.update(coerced)
         return out

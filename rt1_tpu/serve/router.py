@@ -558,6 +558,7 @@ class Router:
                         k: body.get(k)
                         for k in (
                             "compile_count",
+                            "bucket_count",
                             "reloads_total",
                             "requests_total",
                             "active_sessions",

@@ -67,8 +67,17 @@ from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.obs.recorder import ExemplarRing
 from rt1_tpu.serve import reqtrace
-from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
-from rt1_tpu.serve.engine import PolicyEngine, SessionError
+from rt1_tpu.serve.batcher import (
+    BusyError,
+    ContinuousBatcher,
+    DrainingError,
+    MicroBatcher,
+)
+from rt1_tpu.serve.engine import (
+    PolicyEngine,
+    SessionError,
+    SlotContentionError,
+)
 from rt1_tpu.serve.metrics import ServeMetrics
 
 
@@ -142,6 +151,8 @@ class ServeApp:
         max_batch: Optional[int] = None,
         max_delay_s: float = 0.010,
         max_queue: int = 64,
+        scheduler: str = "continuous",
+        pipeline_depth: int = 2,
         request_timeout_s: float = 60.0,
         metrics: Optional[ServeMetrics] = None,
         replica_id: int = 0,
@@ -189,18 +200,50 @@ class ServeApp:
         self._loop_thread = threading.Thread(
             target=self._loop.run_forever, name="rt1-serve-loop", daemon=True
         )
-        self.batcher = MicroBatcher(
-            self._process,
-            # A flush larger than the slot count would make act_batch
-            # reject the whole batch — clamp, don't trust the flag.
-            max_batch=min(max_batch or engine.max_sessions,
-                          engine.max_sessions),
-            max_delay_s=max_delay_s,
-            max_queue=max_queue,
-            batch_key=lambda item: item[0],  # one in-flight step per session
-            metrics=self.metrics,
-            on_batch=self._mark_batch_formed,
-        )
+        if scheduler not in ("continuous", "cycle"):
+            raise ValueError(
+                f"scheduler must be 'continuous' or 'cycle', got "
+                f"{scheduler!r}"
+            )
+        self.scheduler = scheduler
+        self.pipeline_depth = pipeline_depth
+        # A flush larger than the slot count would make act_batch reject
+        # the whole batch — clamp, don't trust the flag.
+        clamped_batch = min(max_batch or engine.max_sessions,
+                            engine.max_sessions)
+        if scheduler == "continuous":
+            # Rolling scheduler + double-buffered engine pipeline: a
+            # request joins the NEXT device step the moment it lands, and
+            # batch N+1 dispatches while batch N's fetch blocks.
+            self.batcher = ContinuousBatcher(
+                self._process,
+                max_batch=clamped_batch,
+                max_queue=max_queue,
+                pipeline_depth=pipeline_depth,
+                # Reused as the demand-coalesce CAP, not a fixed
+                # deadline: a lone client still dispatches immediately;
+                # only a re-forming burst (eligible < distinct sessions
+                # seen lately) waits — at most this long on an idle
+                # device, or until the in-flight step completes when one
+                # is running (its riders rearrive at that moment).
+                coalesce_delay_s=max_delay_s,
+                batch_key=lambda item: item[0],  # session exclusion spans
+                #   in-flight batches: per-session FIFO under overlap
+                metrics=self.metrics,
+                on_batch=self._mark_batch_formed,
+            )
+        else:
+            # Legacy cycle scheduler (the A/B baseline): wait for
+            # deadline-or-full, one batch in flight, ever.
+            self.batcher = MicroBatcher(
+                self._process,
+                max_batch=clamped_batch,
+                max_delay_s=max_delay_s,
+                max_queue=max_queue,
+                batch_key=lambda item: item[0],
+                metrics=self.metrics,
+                on_batch=self._mark_batch_formed,
+            )
 
     @staticmethod
     def _mark_batch_formed(items) -> None:
@@ -221,9 +264,23 @@ class ServeApp:
         with reqtrace.device_step_span(
             len(items), (ph.request_id for _, _, ph in items)
         ):
-            results = self.engine.act_batch(
-                [(sid, obs) for sid, obs, _ in items]
-            )
+            batch = [(sid, obs) for sid, obs, _ in items]
+            if hasattr(self.engine, "dispatch_batch"):
+                # Two-phase step: the async dispatch returns immediately
+                # (under the engine lock) and the blocking fetch runs
+                # outside it — with the continuous batcher's second
+                # executor worker, batch N+1 dispatches while this fetch
+                # blocks (the double-buffered device pipeline). Nothing
+                # may sit between dispatch and collect: a dropped handle
+                # would leak its sessions' in-flight eviction protection.
+                handle = self.engine.dispatch_batch(batch)
+                results = self.engine.collect_batch(handle)
+                if handle.bucket is not None:
+                    self.metrics.observe_bucket(
+                        handle.bucket, handle.active_count
+                    )
+            else:
+                results = self.engine.act_batch(batch)
         now = obs_trace.now_us()
         for _, _, phases in items:
             phases.t_device1 = now
@@ -256,24 +313,47 @@ class ServeApp:
         flywheel episodes."""
         if phases is None:
             phases = reqtrace.RequestPhases()
-        with self._admit_lock:
-            # Atomic with drain()'s flag flip: once a request passes this
-            # check it is scheduled on the loop ahead of batcher.drain(),
-            # so SIGTERM flushes it — admitted work is never answered 503.
-            if self.draining:
-                raise DrainingError("draining; not accepting requests")
-            phases.t_enqueue = obs_trace.now_us()
-            future = asyncio.run_coroutine_threadsafe(
-                self.batcher.submit((session_id, obs, phases)), self._loop
-            )
-        try:
-            result = future.result(timeout=self.request_timeout_s)
-        except concurrent.futures.TimeoutError:
-            # Nobody is waiting for this request anymore — cancel it so a
-            # still-queued entry is dropped instead of stepping the
-            # session's rolling state for a dead client.
-            future.cancel()
-            raise
+        t_entry = time.perf_counter()
+        while True:
+            with self._admit_lock:
+                # Atomic with drain()'s flag flip: once a request passes
+                # this check it is scheduled on the loop ahead of
+                # batcher.drain(), so SIGTERM flushes it — admitted work
+                # is never answered 503.
+                if self.draining:
+                    raise DrainingError("draining; not accepting requests")
+                phases.t_enqueue = obs_trace.now_us()
+                future = asyncio.run_coroutine_threadsafe(
+                    self.batcher.submit((session_id, obs, phases)),
+                    self._loop,
+                )
+            try:
+                # Remaining budget, not a fresh one: contention retries
+                # must never stretch a request past request_timeout_s.
+                remaining = self.request_timeout_s - (
+                    time.perf_counter() - t_entry
+                )
+                result = future.result(timeout=max(remaining, 0.001))
+            except concurrent.futures.TimeoutError:
+                # Nobody is waiting for this request anymore — cancel it
+                # so a still-queued entry is dropped instead of stepping
+                # the session's rolling state for a dead client.
+                future.cancel()
+                raise
+            if (
+                isinstance(result.get("error"), SlotContentionError)
+                and time.perf_counter() - t_entry
+                < self.request_timeout_s / 2
+            ):
+                # Every slot was riding this batch or an in-flight step
+                # (double-buffered oversubscription). Transient by
+                # construction — re-ride the next batch server-side
+                # instead of bouncing a 503 retry loop through HTTP;
+                # surfaced as 503 busy only if half the request budget
+                # burns without a slot freeing.
+                time.sleep(0.002)
+                continue
+            break
         if "error" in result:
             # The engine isolates a bad item as a per-item marker so its
             # batchmates still step; surface it to THIS request only.
@@ -385,6 +465,16 @@ class ServeApp:
             "inference_dtype": getattr(
                 self.engine, "inference_dtype", "f32"
             ),
+            # The serve hot-path contract (ISSUE 12): which scheduler
+            # forms batches and which AOT bucket sizes exist —
+            # compile_count is pinned at len(buckets) after warm-up.
+            "scheduler": self.scheduler,
+            "buckets": [
+                int(b)
+                for b in getattr(
+                    self.engine, "buckets", (self.engine.max_sessions,)
+                )
+            ],
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
@@ -405,6 +495,11 @@ class ServeApp:
         return {
             "active_sessions": self.engine.active_sessions,
             "compile_count": self.engine.compile_count,
+            # The compile-count invariant's denominator: compile_count
+            # must equal bucket_count after warm-up and every reload.
+            "bucket_count": len(
+                getattr(self.engine, "buckets", (1,))
+            ),
             "embed_cache_misses": self.engine.embed_calls,
             # Nonzero while serving steady traffic = more live sessions
             # than slots; their context windows are thrashing to zero.
@@ -561,6 +656,12 @@ class _Handler(BaseHTTPRequestHandler):
         except RequestError as exc:
             self._reply(400, {"error": str(exc)})
             return
+        except SlotContentionError as exc:
+            # Transient: every slot is riding an in-flight step (a /reset
+            # claiming a fresh slot under double-buffered saturation) —
+            # retryable 503, same as the /act path.
+            self._reply(503, {"error": str(exc), "retry": True})
+            return
         except SessionError as exc:
             self._reply(404, {"error": str(exc)})
             return
@@ -623,7 +724,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._fail_act(400, phases, session_id, t0,
                                "failed", {"error": str(exc)})
                 return
-            except BusyError:
+            except (BusyError, SlotContentionError):
+                # Queue at max_queue, or every slot riding this batch /
+                # an in-flight step (double-buffered oversubscription) —
+                # both transient by construction: shed retryably.
                 self._fail_act(503, phases, session_id, t0,
                                "rejected",
                                {"error": "busy", "retry": True})

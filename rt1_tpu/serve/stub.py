@@ -65,9 +65,19 @@ class StubReplicaApp:
         reload_delay_s: float = 0.05,
         slow_threshold_ms: float = 0.0,
         inference_dtype: str = "f32",
+        buckets=None,
+        scheduler: str = "continuous",
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
+        # ISSUE 12 scheduling contract, mimicked jax-free: the stub
+        # advertises its bucket ladder and scheduler, pins
+        # compile_count == len(buckets), and books every (batch-of-1)
+        # act into the per-bucket occupancy families — so the tier-1
+        # fleet tests prove the aggregation plumbing without a model.
+        self.buckets = sorted({int(b) for b in (buckets or [1])})
+        self.scheduler = scheduler
+        self.compile_count = len(self.buckets)
         # Advertised low-precision mode (the real replica's engine gauge);
         # lets tier-1 prove mixed-dtype fleet aggregation with no jax.
         self.inference_dtype = inference_dtype
@@ -150,6 +160,11 @@ class StubReplicaApp:
         phases.t_device1 = obs_trace.now_us()
         self.metrics.observe_request(time.perf_counter() - t0)
         self.metrics.observe_batch(1, queued=0)
+        # Smallest advertised bucket that fits a batch of 1 — the same
+        # selection rule PolicyEngine.bucket_for applies.
+        self.metrics.observe_bucket(
+            next((b for b in self.buckets if b >= 1), 1), 1
+        )
         return 200, {
             "action": stub_action(step),
             "action_tokens": [0, step % 256, (step * 3) % 256],
@@ -210,7 +225,11 @@ class StubReplicaApp:
             "embed_dim": EMBED_DIM,
             "max_sessions": self.max_sessions,
             "active_sessions": active,
-            "compile_count": 1,  # the contract field; nothing compiles here
+            # The contract field; nothing compiles here, but the invariant
+            # (compile_count == bucket count) is mimicked exactly.
+            "compile_count": self.compile_count,
+            "buckets": list(self.buckets),
+            "scheduler": self.scheduler,
             "reloads": self.reloads,
             "inference_dtype": self.inference_dtype,
         }
@@ -229,7 +248,8 @@ class StubReplicaApp:
             active = len(self._sessions)
         return {
             "active_sessions": active,
-            "compile_count": 1,
+            "compile_count": self.compile_count,
+            "bucket_count": len(self.buckets),
             "draining": int(self.draining),
             "ready": int(self.ready),
             "reloading": int(self.reloading),
@@ -354,6 +374,15 @@ def main(argv=None) -> int:
         choices=["f32", "bf16", "int8"],
         help="Advertised low-precision mode (protocol double for the "
              "real replica's --inference_dtype).")
+    parser.add_argument(
+        "--buckets", default="1",
+        help="Advertised AOT batch-size buckets (comma ints; protocol "
+             "double for the real replica's --buckets; compile_count is "
+             "reported as the bucket count).")
+    parser.add_argument(
+        "--scheduler", default="continuous",
+        choices=["continuous", "cycle"],
+        help="Advertised batch scheduler (protocol double only).")
     args = parser.parse_args(argv)
 
     # Bounded in-process trace ring so GET /trace (and the fleet tests'
@@ -366,6 +395,8 @@ def main(argv=None) -> int:
         reload_delay_s=args.reload_delay_s,
         slow_threshold_ms=args.slow_threshold_ms,
         inference_dtype=args.inference_dtype,
+        buckets=[int(b) for b in args.buckets.split(",") if b.strip()],
+        scheduler=args.scheduler,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
     if args.startup_delay_s:
@@ -388,7 +419,9 @@ def main(argv=None) -> int:
                 "replica_id": args.replica_id,
                 "checkpoint_step": -1,
                 "max_sessions": args.max_sessions,
-                "compile_count": 1,
+                "compile_count": app.compile_count,
+                "buckets": list(app.buckets),
+                "scheduler": app.scheduler,
                 "inference_dtype": args.inference_dtype,
             }
         ),

@@ -63,6 +63,20 @@ text = ServeMetrics().prometheus_text(active_sessions=0)
 assert "# TYPE rt1_serve_requests_total counter" in text
 assert 'le="+Inf"' in text
 
+# ISSUE 12 serve hot path: the continuous scheduler is stdlib-only (it
+# runs in every replica AND in the jax-free stub/fleet rehearsals), and
+# the new bucket/pipeline metric families render through the same
+# snapshot→text path.
+from rt1_tpu.serve.batcher import ContinuousBatcher  # noqa: F401
+
+m12 = ServeMetrics()
+m12.observe_batch(2, queued=0, in_flight=2, joined_mid_cycle=2)
+m12.observe_bucket(2, 2)
+text12 = m12.prometheus_text(bucket_count=2)
+assert 'rt1_serve_bucket_batches_total{bucket="2"} 1' in text12
+assert "rt1_serve_joined_mid_cycle_total 2" in text12
+assert "rt1_serve_batches_in_flight 2" in text12
+
 # Fleet layer: router, supervisor, and the stub replica are the pieces a
 # model-free router process runs — all must work under the same blocker.
 from rt1_tpu.serve.router import Router
@@ -79,6 +93,13 @@ assert "rt1_serve_slo_error_budget_burn 0" in router_text
 stub = StubReplicaApp(replica_id=7)
 assert stub.healthz()["replica_id"] == 7
 assert stub.readyz()[0] == 200
+# The stub mimics the ISSUE 12 scheduling contract jax-free: bucket
+# ladder advertised, compile_count pinned at the bucket count.
+stub12 = StubReplicaApp(replica_id=8, buckets=[1, 2, 4])
+assert stub12.healthz()["compile_count"] == 3
+assert stub12.healthz()["buckets"] == [1, 2, 4]
+assert stub12.healthz()["scheduler"] == "continuous"
+assert stub12.metrics_snapshot()["bucket_count"] == 3
 
 # PR 8 serving-observability pieces: the SLO ledger, the shared
 # percentile helpers, the request tracer, and the exemplar ring all run

@@ -252,6 +252,64 @@ def test_fleet_snapshot_rendering_labeled_families():
     assert not any(n.endswith("ignored_text") for n, _, _ in samples)
 
 
+def test_bucket_and_pipeline_families_render():
+    """ISSUE 12 naming contract: the per-bucket occupancy histogram
+    renders as labeled `rt1_serve_bucket_*{bucket="N"}` families, and the
+    double-buffer gauges/counters keep their promised names — same
+    numbers through JSON and text."""
+    metrics = ServeMetrics()
+    metrics.observe_batch(1, queued=0, in_flight=1)
+    metrics.observe_batch(2, queued=1, in_flight=2, joined_mid_cycle=2)
+    metrics.observe_inflight(0)
+    metrics.observe_bucket(1, 1)
+    metrics.observe_bucket(2, 2)
+    metrics.observe_bucket(2, 1)
+
+    snap = metrics.snapshot(bucket_count=2)
+    assert snap["joined_mid_cycle_total"] == 2
+    assert snap["batches_in_flight"] == 0
+    assert snap["max_batches_in_flight"] == 2
+    assert snap["bucket_batches"] == {"1": 1, "2": 2}
+    assert snap["bucket_occupancy_sum"] == {"1": 1, "2": 3}
+
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_joined_mid_cycle_total"] == "counter"
+    assert types["rt1_serve_batches_in_flight"] == "gauge"
+    assert types["rt1_serve_max_batches_in_flight"] == "gauge"
+    assert types["rt1_serve_bucket_count"] == "gauge"
+    assert types["rt1_serve_bucket_batches_total"] == "counter"
+    assert types["rt1_serve_bucket_occupancy_sum"] == "counter"
+    assert ("rt1_serve_bucket_batches_total", {"bucket": "2"}, "2") in samples
+    assert (
+        "rt1_serve_bucket_occupancy_sum", {"bucket": "2"}, "3"
+    ) in samples
+    assert ("rt1_serve_joined_mid_cycle_total", {}, "2") in samples
+
+    # Fleet-labeled variants: {replica_id, bucket} double label.
+    fleet_text = prom.render_fleet_snapshot({}, {3: snap})
+    _, fleet_samples = parse_exposition(fleet_text)
+    assert (
+        "rt1_serve_replica_bucket_batches_total",
+        {"replica_id": "3", "bucket": "1"},
+        "1",
+    ) in fleet_samples
+    assert (
+        "rt1_serve_replica_joined_mid_cycle_total",
+        {"replica_id": "3"},
+        "2",
+    ) in fleet_samples
+    assert (
+        "rt1_serve_replica_batches_in_flight",
+        {"replica_id": "3"},
+        "0",
+    ) in fleet_samples
+    # An empty engine (no buckets observed yet) renders no bucket family
+    # rather than an empty header.
+    empty_text = prom.render_serve_snapshot(ServeMetrics().snapshot())
+    assert "rt1_serve_bucket_batches_total" not in empty_text
+
+
 def test_fleet_metric_names_all_renderable():
     """Every name `fleet_metric_names()` promises must be a sanitized,
     renderable family name (the scrape-config contract docs point at)."""
@@ -270,6 +328,10 @@ def test_fleet_metric_names_all_renderable():
     # The dtype family is info-style: it renders from the string gauge,
     # not a numeric field.
     full["inference_dtype"] = "int8"
+    # The per-bucket occupancy families render from the bucket dicts
+    # (ISSUE 12), labeled {replica_id, bucket}.
+    full["bucket_batches"] = {"1": 3, "4": 2}
+    full["bucket_occupancy_sum"] = {"1": 3, "4": 7}
     text = prom.render_fleet_snapshot({}, {0: full})
     types, _ = parse_exposition(text)
     for name in names:

@@ -12,7 +12,12 @@ import time
 
 import pytest
 
-from rt1_tpu.serve.batcher import BusyError, DrainingError, MicroBatcher
+from rt1_tpu.serve.batcher import (
+    BusyError,
+    ContinuousBatcher,
+    DrainingError,
+    MicroBatcher,
+)
 
 
 class RecordingProcessor:
@@ -201,5 +206,255 @@ def test_submit_before_start_raises():
         batcher = MicroBatcher(lambda items: items)
         with pytest.raises(RuntimeError, match="not started"):
             await batcher.submit("x")
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------- ContinuousBatcher
+
+
+def test_continuous_dispatches_immediately():
+    """No deadline wait: a lone request rides a device step the moment it
+    lands — the low-occupancy p50 win of the rolling scheduler."""
+    proc = RecordingProcessor()
+
+    async def run():
+        batcher = ContinuousBatcher(proc, max_batch=8)
+        await batcher.start()
+        t0 = time.perf_counter()
+        result = await batcher.submit("a")
+        elapsed = time.perf_counter() - t0
+        await batcher.drain()
+        return result, elapsed
+
+    result, elapsed = asyncio.run(run())
+    assert result == "r:a"
+    assert elapsed < 1.0  # no 10 ms-style deadline, no batchmate wait
+    assert proc.batches == [["a"]]
+
+
+def test_continuous_requests_join_next_step_mid_cycle():
+    """Requests landing while step N runs ride step N+1 together the
+    moment N completes — continuous batching's occupancy mechanism."""
+    release = threading.Event()
+    started = threading.Event()
+    batches = []
+
+    def blocking_proc(items):
+        batches.append(list(items))
+        if items == ["head"]:
+            started.set()
+            release.wait(10)
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        batcher = ContinuousBatcher(
+            blocking_proc, max_batch=8, pipeline_depth=1
+        )
+        await batcher.start()
+        head = asyncio.ensure_future(batcher.submit("head"))
+        await loop.run_in_executor(None, started.wait, 10)
+        riders = [
+            asyncio.ensure_future(batcher.submit(i)) for i in range(3)
+        ]
+        await asyncio.sleep(0.05)  # all three land while head is in flight
+        release.set()
+        results = await asyncio.gather(head, *riders)
+        await batcher.drain()
+        return results
+
+    results = asyncio.run(run())
+    assert results == ["r:head", "r:0", "r:1", "r:2"]
+    # One batch for head, then ONE batch carrying every mid-cycle rider —
+    # nobody waited a full extra cycle.
+    assert batches == [["head"], [0, 1, 2]]
+
+
+def test_continuous_pipeline_depth_overlaps_batches():
+    """With pipeline_depth=2, a second batch dispatches while the first
+    is still executing (the double-buffer overlap), and a third waits
+    for a slot."""
+    gate = threading.Event()
+    lock = threading.Lock()
+    running = {"now": 0, "max": 0}
+
+    def slow_proc(items):
+        with lock:
+            running["now"] += 1
+            running["max"] = max(running["max"], running["now"])
+        gate.wait(10)
+        with lock:
+            running["now"] -= 1
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        batcher = ContinuousBatcher(
+            slow_proc, max_batch=1, pipeline_depth=2
+        )
+        await batcher.start()
+        futures = [
+            asyncio.ensure_future(batcher.submit(i)) for i in range(3)
+        ]
+        await asyncio.sleep(0.2)  # let the scheduler saturate the pipeline
+        inflight_while_busy = batcher.inflight()
+        gate.set()
+        results = await asyncio.gather(*futures)
+        await batcher.drain()
+        return results, inflight_while_busy
+
+    results, inflight_while_busy = asyncio.run(run())
+    assert results == ["r:0", "r:1", "r:2"]
+    assert inflight_while_busy == 2  # two in flight, the third queued
+    assert running["max"] == 2  # true executor-level overlap
+
+
+def test_continuous_session_exclusion_across_overlapping_steps():
+    """A key riding an in-flight step must NOT join an overlapping step:
+    its second request waits for the first step's results. Another key's
+    request lands in the same wait (below-target work holds for the
+    in-flight riders rather than fragmenting), and both ride ONE batch
+    the moment step N completes — with per-key FIFO preserved."""
+    release_head = threading.Event()
+    head_started = threading.Event()
+    batches = []
+
+    def blocking_proc(items):
+        batches.append(list(items))
+        if any(key == "a" and i == 0 for key, i in items):
+            head_started.set()
+            release_head.wait(10)
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        batcher = ContinuousBatcher(
+            blocking_proc,
+            max_batch=8,
+            pipeline_depth=2,
+            batch_key=lambda item: item[0],
+        )
+        await batcher.start()
+        first_a = asyncio.ensure_future(batcher.submit(("a", 0)))
+        await loop.run_in_executor(None, head_started.wait, 10)
+        # ("a", 1) must wait out step N (exclusion); ("b", 0) coalesces
+        # behind the same completion instead of riding a fragment.
+        second_a = asyncio.ensure_future(batcher.submit(("a", 1)))
+        b = asyncio.ensure_future(batcher.submit(("b", 0)))
+        await asyncio.sleep(0.2)
+        while_in_flight = list(batches)
+        release_head.set()
+        results = await asyncio.gather(first_a, second_a, b)
+        await batcher.drain()
+        return results, while_in_flight
+
+    results, while_in_flight = asyncio.run(run())
+    assert results == ["r:('a', 0)", "r:('a', 1)", "r:('b', 0)"]
+    # Nothing overlapped a@0's step: a@1 was excluded by key, b held for
+    # the rearrival burst.
+    assert while_in_flight == [[("a", 0)]]
+    # One post-completion batch carried both waiters (no extra cycle).
+    assert batches == [[("a", 0)], [("a", 1), ("b", 0)]]
+    # Per-key FIFO preserved, and no batch ever carried a duplicate key.
+    a_seq = [i for batch in batches for key, i in batch if key == "a"]
+    assert a_seq == [0, 1]
+    for batch in batches:
+        keys = [key for key, _ in batch]
+        assert len(keys) == len(set(keys)), batch
+
+
+def test_continuous_drain_with_batch_in_flight_loses_nothing():
+    """SIGTERM-under-double-buffering contract: drain flushes the
+    in-flight batch AND everything queued behind it — every admitted
+    request resolves exactly once, new submissions are refused."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def blocking_proc(items):
+        if not started.is_set():
+            started.set()
+            release.wait(10)
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        batcher = ContinuousBatcher(
+            blocking_proc, max_batch=2, pipeline_depth=2
+        )
+        await batcher.start()
+        head = asyncio.ensure_future(batcher.submit("head"))
+        await loop.run_in_executor(None, started.wait, 10)
+        queued = [
+            asyncio.ensure_future(batcher.submit(i)) for i in range(5)
+        ]
+        await asyncio.sleep(0.05)
+        drain = asyncio.ensure_future(batcher.drain())
+        await asyncio.sleep(0.05)
+        release.set()
+        await drain
+        results = await asyncio.gather(head, *queued)
+        with pytest.raises(DrainingError):
+            await batcher.submit("late")
+        return results
+
+    results = asyncio.run(run())
+    # No lost responses, no duplicates: exactly one result per request.
+    assert results == ["r:head"] + [f"r:{i}" for i in range(5)]
+
+
+def test_continuous_backpressure_and_cancel():
+    """Bounded queue sheds at max_queue with BusyError; an abandoned
+    submitter's queued request is dropped before processing."""
+    release = threading.Event()
+    started = threading.Event()
+    proc_batches = []
+
+    def blocking_proc(items):
+        proc_batches.append(list(items))
+        if not release.is_set():
+            started.set()
+            release.wait(10)
+        return [f"r:{item}" for item in items]
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        batcher = ContinuousBatcher(
+            blocking_proc, max_batch=1, max_queue=2, pipeline_depth=1
+        )
+        await batcher.start()
+        head = asyncio.ensure_future(batcher.submit("head"))
+        await loop.run_in_executor(None, started.wait, 10)
+        queued = [
+            asyncio.ensure_future(batcher.submit(i)) for i in range(2)
+        ]
+        await asyncio.sleep(0)
+        with pytest.raises(BusyError):
+            await batcher.submit("overflow")
+        # Abandon the first queued request; it must never reach the
+        # processor.
+        queued[0].cancel()
+        release.set()
+        results = await asyncio.gather(head, queued[1])
+        await batcher.drain()
+        return results
+
+    results = asyncio.run(run())
+    assert results == ["r:head", "r:1"]
+    assert [0] not in proc_batches  # the cancelled request was dropped
+
+
+def test_continuous_process_error_propagates():
+    def failing_proc(items):
+        raise RuntimeError("device fell over")
+
+    async def run():
+        batcher = ContinuousBatcher(failing_proc, max_batch=4)
+        await batcher.start()
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await batcher.submit("x")
+        # The scheduler survives a failing batch and serves the next one.
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await batcher.submit("y")
+        await batcher.drain()
 
     asyncio.run(run())

@@ -13,7 +13,13 @@ import pytest
 
 from rt1_tpu.eval.embedding import HashInstructionEmbedder
 from rt1_tpu.eval.policy import RT1EvalPolicy
-from rt1_tpu.serve.engine import PolicyEngine, SessionError
+from rt1_tpu.serve.engine import (
+    PolicyEngine,
+    SessionError,
+    SlotContentionError,
+    normalize_buckets,
+    pow2_buckets,
+)
 
 H, W, D = 32, 56, 512
 T = 3
@@ -341,6 +347,139 @@ def test_hot_swap_rejects_bad_checkpoints_and_keeps_serving(tiny_setup):
     result = engine.act("s", obs)  # old params still serving
     assert "action" in result
     assert engine.compile_count == 1
+
+
+def test_bucket_selection_deterministic(tiny_setup):
+    """Bucket ladder semantics are pure host-side arithmetic: smallest
+    configured bucket that fits, normalization always tops the ladder at
+    max_sessions, and out-of-range sizes are hard errors."""
+    model, variables = tiny_setup
+    assert pow2_buckets(8) == [1, 2, 4, 8]
+    assert pow2_buckets(6) == [1, 2, 4, 6]
+    assert pow2_buckets(1) == [1]
+    assert normalize_buckets(None, 8) == (8,)
+    assert normalize_buckets([2, 2, 1], 4) == (1, 2, 4)  # topped + deduped
+    with pytest.raises(ValueError, match="within"):
+        normalize_buckets([0, 2], 4)
+    with pytest.raises(ValueError, match="within"):
+        normalize_buckets([16], 8)
+
+    engine = PolicyEngine(
+        model, variables, max_sessions=8, buckets=[1, 2, 4, 8]
+    )
+    assert engine.buckets == (1, 2, 4, 8)
+    assert [engine.bucket_for(k) for k in range(1, 9)] == [
+        1, 2, 4, 4, 8, 8, 8, 8,
+    ]
+    with pytest.raises(ValueError, match="outside"):
+        engine.bucket_for(0)
+    with pytest.raises(ValueError, match="outside"):
+        engine.bucket_for(9)
+
+
+def test_bucketed_warmup_pins_compile_count_across_reloads(tiny_setup):
+    """The ISSUE 12 invariant: warmup precompiles EVERY configured bucket
+    (no live request ever pays a compile), compile_count == len(buckets),
+    and a hot-swap reload moves neither number."""
+    model, variables = tiny_setup
+    engine = PolicyEngine(
+        model, variables, max_sessions=4, buckets=[1, 2, 4]
+    )
+    engine.warmup((H, W, 3), embed_dim=D)
+    assert engine.compile_count == 3 == len(engine.buckets)
+    # Traffic at every size rides a precompiled bucket — no new compiles.
+    streams = {sid: _obs_stream(60 + i, 3) for i, sid in enumerate("abc")}
+    engine.act("a", streams["a"][0])
+    engine.act_batch([("a", streams["a"][1]), ("b", streams["b"][0])])
+    engine.act_batch(
+        [(sid, streams[sid][2 if sid == "a" else 1]) for sid in "abc"]
+    )
+    assert engine.compile_count == 3
+    # Hot-swap keeps the pin (the satellite bar: after reload too).
+    engine.swap_variables(_host_copy(variables))
+    assert engine.reloads == 1
+    engine.act("a", _obs_stream(63, 1)[0])
+    assert engine.compile_count == 3
+
+
+def test_bucketed_tokens_bit_identical_to_full_path(tiny_setup):
+    """At identical batch composition, the bucketed engine's action
+    tokens are bit-identical to the old full-padding path (a single
+    max_sessions-sized bucket) — bucket choice is a pure latency
+    optimization, never a policy change."""
+    model, variables = tiny_setup
+    bucketed = PolicyEngine(
+        model, variables, max_sessions=4, buckets=[1, 2, 4]
+    )
+    full = PolicyEngine(model, variables, max_sessions=4)  # old semantics
+    assert full.buckets == (4,)
+    streams = {sid: _obs_stream(70 + i, 4) for i, sid in enumerate("abc")}
+    step = {sid: 0 for sid in "abc"}
+    # Compositions crossing every bucket (1, 2, 4→pad) and the T=3 roll.
+    for comp in (["a"], ["a", "b"], ["a", "b", "c"], ["b"], ["a", "c"]):
+        items = [(sid, streams[sid][step[sid]]) for sid in comp]
+        rb = bucketed.act_batch(items)
+        rf = full.act_batch(items)
+        for got, ref in zip(rb, rf):
+            np.testing.assert_array_equal(
+                got["action_tokens"], ref["action_tokens"]
+            )
+            np.testing.assert_allclose(
+                got["action"], ref["action"], atol=1e-6
+            )
+        for sid in comp:
+            step[sid] += 1
+    assert bucketed.compile_count == 3
+    assert full.compile_count == 1
+
+
+def test_pipelined_dispatch_matches_serial_act(tiny_setup):
+    """Double-buffer correctness: dispatching steps 1..3 for one session
+    BEFORE collecting any of them (XLA orders them through the donated
+    state) yields bit-identical tokens to stepping serially."""
+    model, variables = tiny_setup
+    piped = PolicyEngine(model, variables, max_sessions=2, buckets=[1, 2])
+    serial = PolicyEngine(model, variables, max_sessions=2, buckets=[1, 2])
+    stream = _obs_stream(80, 4)  # crosses the T=3 roll boundary
+    handles = [piped.dispatch_batch([("s", obs)]) for obs in stream]
+    assert piped.batches_in_flight == len(stream)
+    piped_results = [piped.collect_batch(h)[0] for h in handles]
+    assert piped.batches_in_flight == 0
+    serial_results = [serial.act("s", obs) for obs in stream]
+    for got, ref in zip(piped_results, serial_results):
+        np.testing.assert_array_equal(
+            got["action_tokens"], ref["action_tokens"]
+        )
+        np.testing.assert_array_equal(got["action"], ref["action"])
+    with pytest.raises(RuntimeError, match="already collected"):
+        piped.collect_batch(handles[0])
+
+
+def test_inflight_sessions_protected_from_eviction(tiny_setup):
+    """Session exclusion across overlapping steps, engine side: while a
+    step is in flight its riders cannot be LRU-evicted — a newcomer gets
+    a retryable SlotContentionError marker instead, and succeeds once
+    the step is collected."""
+    model, variables = tiny_setup
+    engine = PolicyEngine(model, variables, max_sessions=2, buckets=[1, 2])
+    obs = _obs_stream(85, 2)
+    in_flight = engine.dispatch_batch([("a", obs[0]), ("b", obs[0])])
+    # Both slots ride the in-flight step: "c" cannot claim one.
+    contended = engine.act_batch([("c", obs[0])])
+    assert isinstance(contended[0]["error"], SlotContentionError)
+    assert engine.evictions == 0
+    assert sorted(engine.session_ids()) == ["a", "b"]
+    # /reset honors the same protection: a NEW session's reset cannot
+    # evict a rider mid-step either (retryable, not silent corruption).
+    with pytest.raises(SlotContentionError):
+        engine.reset("c")
+    results = engine.collect_batch(in_flight)
+    assert all("action" in r for r in results)
+    # Collected: the LRU session ("a") is reclaimable again.
+    retried = engine.act_batch([("c", obs[1])])
+    assert "action" in retried[0]
+    assert engine.evictions == 1
+    assert sorted(engine.session_ids()) == ["b", "c"]
 
 
 def test_warmup_is_the_only_compile(tiny_setup):

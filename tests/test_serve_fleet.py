@@ -113,6 +113,11 @@ def test_fleet_ready_with_proxied_contract(fleet):
     assert all(
         r["metrics"]["compile_count"] == 1 for r in fs["replicas"]
     )
+    # ISSUE 12 scheduling contract rides the stub fleet jax-free: the
+    # compile-count invariant's denominator is probed per replica.
+    assert all(
+        r["metrics"]["bucket_count"] == 1 for r in fs["replicas"]
+    )
 
 
 def test_session_affinity_and_spread(fleet):
@@ -435,8 +440,17 @@ def test_fleet_chaos_loadgen_real_replicas(tmp_path):
     assert result["chaos"]["kills_injected"] == 1
     assert result["chaos"]["reloads_injected"] == 1
     assert result["replica_restarts_total"] == 1
-    # One XLA compile per replica lifetime, kill + respawn included.
-    assert all(c == 1 for c in result["replica_compile_counts"])
+    # The pinned-compile invariant, kill + respawn included: every
+    # replica compiled exactly once per AOT batch-size bucket (the
+    # default --buckets auto ladder), never more.
+    assert result["replica_compile_counts"], result
+    assert all(
+        c == b and b >= 1
+        for c, b in zip(
+            result["replica_compile_counts"],
+            result["replica_bucket_counts"],
+        )
+    ), result
     # SLO ledger rides the BENCH record: the kill+reload scenario burns
     # nonzero error budget (the restarted requests) while availability
     # stays above the objective — degraded, within contract.
