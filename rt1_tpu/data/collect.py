@@ -26,6 +26,32 @@ from rt1_tpu.envs import rewards as rewards_module
 from rt1_tpu.envs.oracles import RRTPushOracle
 from rt1_tpu.eval.embedding import get_embedder
 
+# ONE spelling of the untagged-episode slug for every consumer (pack
+# cache, feeder mixture weights, eval matrix, serve labels). Defined in
+# pack.py (numpy+stdlib only — importable from anywhere); re-exported
+# here because collect.py is the task-stamping authority callers import.
+from rt1_tpu.data.pack import UNKNOWN_TASK
+
+
+def canonical_task_id(reward_name) -> str:
+    """The per-episode task id stamped into episodes and pack manifests.
+
+    Reward names in the canonical family registry pass through unchanged
+    (the task id IS the reward family); anything else — a custom reward
+    class, an experimental family, a typo — maps to the stable
+    ``"unknown:<reward_name>"`` slug instead of being dropped, so the
+    episode still lands in a (distinguishable) mixture bucket and the
+    task-frequency dashboards show *something* rather than silently
+    folding it into a canonical family. An empty/None name degrades to
+    plain ``"unknown"``.
+    """
+    if not reward_name:
+        return UNKNOWN_TASK
+    name = str(reward_name)
+    if name in rewards_module.REWARD_FAMILIES:
+        return name
+    return f"{UNKNOWN_TASK}:{name}"
+
 
 def collect_episode(
     env,
@@ -148,7 +174,7 @@ def collect_dataset(
         ep = collect_episode(
             env, oracle, embed_fn, max_steps=max_steps, image_hw=image_hw,
             exec_noise_std=exec_noise_std, noise_rng=noise_rng,
-            task=reward_name,
+            task=canonical_task_id(reward_name),
         )
         if ep is None:
             continue
@@ -271,7 +297,7 @@ def _collect_shard(shard_dir, count, seed, kwargs):
             image_hw=kwargs.get("image_hw"),
             exec_noise_std=kwargs.get("exec_noise_std", 0.0),
             noise_rng=noise_rng,
-            task=kwargs.get("reward_name", "block2block"),
+            task=canonical_task_id(kwargs.get("reward_name", "block2block")),
         )
         if ep is None:
             continue

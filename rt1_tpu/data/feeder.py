@@ -37,13 +37,74 @@ import bisect
 import queue
 import threading
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+import zlib
+from typing import Dict, Iterator, List, Mapping, Optional, Tuple
 
 import numpy as np
 
-from rt1_tpu.data.pack import PackedEpisodeCache
+from rt1_tpu.data.pack import UNKNOWN_TASK, PackedEpisodeCache
+from rt1_tpu.obs.health import TASK_ID_KEY
 from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.resilience import faults
+
+#: Trailing task-id bucket for episodes whose task appeared AFTER feeder
+#: construction (a flywheel append introducing a brand-new workload tag):
+#: the health pack's layout is frozen at step-build time, so late tasks
+#: land in one stable overflow bucket instead of shifting the layout.
+OTHER_TASK = "other"
+
+
+def parse_task_weights(spec) -> Optional[Dict[str, float]]:
+    """``"block2block:3,corner:1"`` -> ``{"block2block": 3.0, "corner": 1.0}``.
+
+    The config-string form of per-task sampling weights
+    (``config.data.task_weights``) — a string so a single
+    ``--config.data.task_weights=...`` CLI override works. ``None``/empty
+    returns None (mixture sampling off, the bit-identical pre-task
+    stream). A mapping passes through (validated). Weights must be
+    non-negative with at least one positive; a task absent from the
+    corpus simply never matches (the feeder validates coverage against
+    the actual corpus at order-draw time). The special key ``"*"`` sets
+    the weight for every task not named explicitly (default 0 = excluded).
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, Mapping):
+        items = dict(spec)
+    else:
+        text = str(spec).strip()
+        if not text:
+            return None
+        items = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            # rsplit: task slugs may themselves contain ':' ("unknown:foo").
+            name, _, weight = part.rpartition(":")
+            if not name:
+                raise ValueError(
+                    f"task_weights entry {part!r} is not '<task>:<weight>'"
+                )
+            items[name] = weight
+    out = {}
+    for name, weight in items.items():
+        try:
+            w = float(weight)
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"task_weights[{name!r}] = {weight!r} is not a number"
+            ) from exc
+        if w < 0 or not np.isfinite(w):
+            raise ValueError(
+                f"task_weights[{name!r}] = {w} must be finite and >= 0"
+            )
+        out[name] = w
+    if not out:
+        return None
+    if not any(v > 0 for v in out.values()):
+        raise ValueError(f"task_weights {out} has no positive weight")
+    return out
 
 
 class FeederStalledError(RuntimeError):
@@ -80,6 +141,8 @@ class SampleAheadFeeder:
         start: bool = True,
         stall_timeout_s: Optional[float] = None,
         refresh_at_epoch: bool = False,
+        task_weights: Optional[Mapping[str, float]] = None,
+        emit_task_ids: bool = False,
     ):
         if batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {batch_size}")
@@ -99,6 +162,43 @@ class SampleAheadFeeder:
         self.process_index = process_index
         self.process_count = process_count
         self.refresh_at_epoch = refresh_at_epoch
+        # Task-mixture sampling (docs/data.md "Task-mixture sampling"):
+        # with weights, each epoch's order is a weighted draw WITH
+        # replacement over the corpus windows (p_i ∝ weight of window i's
+        # task), still a pure function of (seed, epoch, corpus, weights) —
+        # the weights fold into the shuffle rng key, so two feeders with
+        # the same tuple emit byte-identical streams and weights=None is
+        # the exact pre-task permutation path.
+        self.task_weights = parse_task_weights(task_weights)
+        if self.task_weights is not None and not shuffle:
+            raise ValueError(
+                "task_weights requires shuffle=True (a weighted epoch is "
+                "a sampled mixture, not a deterministic corpus walk)"
+            )
+        self._weights_key = (
+            zlib.crc32(
+                repr(sorted(self.task_weights.items())).encode("utf-8")
+            )
+            if self.task_weights is not None
+            else 0
+        )
+        # Per-task telemetry: emit a (batch,) int32 `task_id` member the
+        # jitted step's one-hot segment reduction consumes. The id table
+        # is frozen at construction (sorted unique corpus tasks + one
+        # trailing OTHER_TASK overflow bucket), so the health-pack layout
+        # is static even while the flywheel grows the corpus mid-run. A
+        # corpus that already carries a literal "other" task shares that
+        # bucket with post-append novel tasks (no duplicate pack entry).
+        self.emit_task_ids = emit_task_ids
+        self._task_index = {
+            name: i for i, name in enumerate(sorted(set(cache.tasks)))
+        }
+        names = tuple(sorted(self._task_index))
+        if OTHER_TASK not in self._task_index:
+            names = names + (OTHER_TASK,)
+        self.health_task_names: Tuple[str, ...] = (
+            names if emit_task_ids else ()
+        )
 
         # Per-epoch corpus snapshots: each entry pins the window count and
         # shuffle order one epoch's batches are drawn from, so a flywheel
@@ -153,13 +253,58 @@ class SampleAheadFeeder:
 
     def _compute_order(self, epoch: int, n_windows: int) -> np.ndarray:
         """This process's window order for `epoch` over an `n_windows`
-        corpus — a pure function of (seed, epoch, n_windows), so every
-        feeder that sees the same corpus at epoch e draws the same order
-        no matter when the corpus reached that size."""
+        corpus — a pure function of (seed, epoch, n_windows[, weights]),
+        so every feeder that sees the same corpus at epoch e draws the
+        same order no matter when the corpus reached that size.
+
+        task_weights=None keeps the EXACT pre-task permutation draw (same
+        rng key, same shuffle — bit-identical, pinned in tests). With
+        weights, the epoch becomes a weighted draw with replacement
+        (p_window ∝ weight of its episode's task), the weights digest
+        folded into the rng key so different mixtures give different —
+        but individually reproducible — streams.
+        """
+        if self.task_weights is not None:
+            w = self._window_weights(n_windows)
+            total = w.sum()
+            if total <= 0:
+                raise ValueError(
+                    f"task_weights {self.task_weights} give zero total "
+                    f"weight over this corpus (tasks: "
+                    f"{sorted(set(self.cache.tasks[:]))})"
+                )
+            rng = np.random.default_rng(
+                [self.seed, epoch, self._weights_key]
+            )
+            order = rng.choice(
+                n_windows, size=n_windows, replace=True, p=w / total
+            )
+            return order[self.process_index :: self.process_count]
         order = np.arange(n_windows)
         if self.shuffle:
             np.random.default_rng([self.seed, epoch]).shuffle(order)
         return order[self.process_index :: self.process_count]
+
+    def _window_weights(self, n_windows: int) -> np.ndarray:
+        """(n_windows,) float64 sampling weight per window: the window's
+        episode task looked up in `task_weights` (missing tasks fall back
+        to the ``"*"`` wildcard weight, default 0 = excluded). Windows are
+        laid out episode-by-episode in `cache.index`, so the first
+        `n_windows` entries are an episode prefix and one np.repeat
+        covers them."""
+        default = self.task_weights.get("*", 0.0)
+        ep_weights, ep_steps, covered = [], [], 0
+        for entry in self.cache.episodes:
+            if covered >= n_windows:
+                break
+            steps = min(int(entry["steps"]), n_windows - covered)
+            task = entry.get("task") or UNKNOWN_TASK
+            ep_weights.append(self.task_weights.get(task, default))
+            ep_steps.append(steps)
+            covered += steps
+        return np.repeat(
+            np.asarray(ep_weights, np.float64), np.asarray(ep_steps, np.int64)
+        )
 
     def _materialize_next_epoch_locked_unsafe(self) -> None:
         """Append the next epoch's snapshot; caller holds `_order_lock`
@@ -263,6 +408,18 @@ class SampleAheadFeeder:
             "image": images,
             "natural_language_embedding": embeds,
         }
+        if self.emit_task_ids:
+            # (batch,) int32 ids into `health_task_names`; tasks unseen at
+            # construction (post-append workloads) ride the OTHER_TASK
+            # bucket so the step's one-hot layout never shifts.
+            other = self._task_index.get(OTHER_TASK, len(self._task_index))
+            tid = np.empty((n,), np.int32)
+            for j, idx in enumerate(indices):
+                entry = self.cache.episodes[self.cache.index[int(idx)][0]]
+                tid[j] = self._task_index.get(
+                    entry.get("task") or UNKNOWN_TASK, other
+                )
+            observations[TASK_ID_KEY] = tid
         if self.cache._clip_tokenizer is not None:
             tokens = np.stack(
                 [
@@ -365,6 +522,7 @@ class SampleAheadFeeder:
             "corpus_windows": float(len(c.index)),
             "corpus_steps": float(getattr(c, "total_steps", 0)),
             "corpus_episodes": float(len(c.episodes)),
+            "corpus_tasks": float(len(set(c.tasks))),
             "appended_episodes": float(getattr(c, "appended_episodes", 0)),
             "refreshes": float(getattr(c, "refreshes", 0)),
             "staleness_s": max(

@@ -73,6 +73,12 @@ LEGACY_FORMAT_VERSION = 2
 META_MEMBERS = ("action", "instruction", "is_first", "is_terminal")
 TEXT_MEMBER = "instruction_text"
 TEXT_NAME = "meta_instruction_text.npy"
+#: Task id reported for episodes whose manifest entry carries no `task`
+#: meta (legacy format-2 packs, pre-task corpora). THE definition of the
+#: slug — pack.py is numpy+stdlib only, so every consumer (collect's
+#: stamping path, the feeder's mixture weights, the eval matrix) imports
+#: this one spelling.
+UNKNOWN_TASK = "unknown"
 
 
 def shard_suffix(k: int) -> str:
@@ -653,16 +659,20 @@ class PackedEpisodeCache:
             if s.get("appended")
         )
 
-    def episode_task(self, ep_i: int) -> Optional[str]:
+    def episode_task(self, ep_i: int) -> str:
         """The per-episode task id carried through capture/pack metas
-        (reward family, capture workload tag), or None for untagged
-        corpora — the hook task-mixture sampling weights against."""
-        return self.episodes[ep_i].get("task")
+        (reward family, capture workload tag) — the hook task-mixture
+        sampling weights against. Episodes packed before task stamping
+        existed (legacy format-2 manifests, untagged corpora) report the
+        stable ``UNKNOWN_TASK`` slug instead of None/raising, so mixture
+        weights and per-task telemetry always see a string id."""
+        return self.episodes[ep_i].get("task") or UNKNOWN_TASK
 
     @property
-    def tasks(self) -> List[Optional[str]]:
-        """Per-episode task ids, index-aligned with `episodes`."""
-        return [e.get("task") for e in self.episodes]
+    def tasks(self) -> List[str]:
+        """Per-episode task ids, index-aligned with `episodes` (untagged
+        episodes report ``UNKNOWN_TASK``)."""
+        return [e.get("task") or UNKNOWN_TASK for e in self.episodes]
 
     def refresh(self) -> bool:
         """Pick up shards appended since open; True when the corpus grew.

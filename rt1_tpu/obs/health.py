@@ -40,6 +40,14 @@ unpack the fetched vector by position. Entries:
   collapse diagnosed in RESULTS.md shows up here first).
 * ``health/token_acc/dim<k>``      — per-action-dimension token accuracy
   of the argmax prediction against the label, one entry per action token.
+* ``health/task_loss/<task>`` / ``health/task_acc/<task>`` /
+  ``health/task_frac/<task>`` — per-task mean loss, token accuracy, and
+  batch share, present only when the feeder emits per-example task ids
+  (:data:`TASK_ID_KEY`; ``SampleAheadFeeder(emit_task_ids=True)``).
+  Computed by a one-hot segment reduction inside the step — the
+  multi-task quality signal (which reward families the policy is
+  actually learning) at zero extra host syncs. A task absent from a
+  batch reports loss/acc 0 with frac 0; read frac first.
 
 Import-light by contract: jax only inside functions (pinned by
 tests/test_obs_imports.py).
@@ -53,6 +61,13 @@ from typing import Any, Dict, List, Mapping, Sequence, Tuple
 #: train loop pops it before `scalars_from_metrics` (a vector has no
 #: meaningful scalar mean) and unpacks it against `TrainStepFns.health_names`.
 PACK_KEY = "health_pack"
+
+#: Observation key carrying the per-example int32 task ids the feeder
+#: emits (`SampleAheadFeeder(emit_task_ids=True)`). The step builder
+#: strips it from the observations BEFORE the model forward and threads
+#: it to `compute_pack` for the per-task one-hot segment reduction — the
+#: model never sees it.
+TASK_ID_KEY = "task_id"
 
 #: Guard against division by a zero param norm (fresh zeros-init leaves).
 _EPS = 1e-12
@@ -89,8 +104,16 @@ def pack_names(
     depth: int = DEFAULT_GROUP_DEPTH,
     action_dims: int = 0,
     prefix: str = "health/",
+    task_names: Sequence[str] = (),
 ) -> Tuple[str, ...]:
-    """The pack's entry names, in pack order (host-side contract)."""
+    """The pack's entry names, in pack order (host-side contract).
+
+    `task_names` (non-empty only when the data stream carries per-example
+    task ids AND the step produces action statistics) appends the
+    per-task telemetry block: ``task_loss/<t>``, ``task_acc/<t>``,
+    ``task_frac/<t>`` per task, in `task_names` order — the model-quality
+    signals the eval matrix reads live as ``rt1_train_health_task_*``.
+    """
     groups = param_groups(params, depth)
     names = [f"{prefix}grad_norm/{g}" for g in groups]
     names += [f"{prefix}update_ratio/{g}" for g in groups]
@@ -98,6 +121,9 @@ def pack_names(
     if action_dims > 0:
         names.append(f"{prefix}logit_entropy")
         names += [f"{prefix}token_acc/dim{k}" for k in range(action_dims)]
+        names += [f"{prefix}task_loss/{t}" for t in task_names]
+        names += [f"{prefix}task_acc/{t}" for t in task_names]
+        names += [f"{prefix}task_frac/{t}" for t in task_names]
     return tuple(names)
 
 
@@ -150,6 +176,7 @@ def compute_pack(
     out: Mapping[str, Any],
     depth: int = DEFAULT_GROUP_DEPTH,
     action_dims: int = 0,
+    task_names: Sequence[str] = (),
 ):
     """Build the packed health vector inside the traced train step.
 
@@ -202,6 +229,26 @@ def compute_pack(
                 f"{per_dim.shape[0]} action token dims"
             )
         parts.append(per_dim)
+        if task_names:
+            # Per-task loss / token accuracy / batch share via ONE one-hot
+            # segment reduction (K = len(task_names) matmuls fused by XLA):
+            # the multi-task training signal, still zero host sync — it
+            # rides the same replicated pack vector. Tasks absent from
+            # this batch report 0 with frac 0 (readable as "no data", not
+            # "perfectly learned": dashboards gate on task_frac).
+            task_ids = jnp.asarray(out["task_ids"], jnp.int32)  # (b,)
+            per_ex_loss = jnp.mean(
+                jnp.asarray(out["action_loss"], jnp.float32), axis=-1
+            )  # (b,)
+            per_ex_acc = jnp.mean(correct, axis=(1, 2))  # (b,)
+            onehot = jax.nn.one_hot(
+                task_ids, len(task_names), dtype=jnp.float32
+            )  # (b, K)
+            counts = jnp.sum(onehot, axis=0)  # (K,)
+            denom = jnp.maximum(counts, 1.0)
+            parts.append(onehot.T @ per_ex_loss / denom)
+            parts.append(onehot.T @ per_ex_acc / denom)
+            parts.append(counts / task_ids.shape[0])
     return jnp.concatenate(parts).astype(jnp.float32)
 
 

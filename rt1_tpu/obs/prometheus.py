@@ -182,20 +182,54 @@ _SERVE_HISTOGRAMS = {
     "step": ("step_latency_seconds", "Batched device step latency."),
 }
 
-# snapshot dict keys -> (family, type, help): the per-AOT-bucket occupancy
-# histogram, rendered with a `bucket` label per compiled batch size.
-_SERVE_BUCKET_FAMILIES = (
+def _numeric_label_key(kv):
+    """Sort key for numeric label values (AOT bucket sizes)."""
+    return int(kv[0])
+
+
+def _lexical_label_key(kv):
+    """Sort key for string label values (task slugs)."""
+    return str(kv[0])
+
+
+# snapshot dict keys -> (family, type, label, sort_key, help): snapshot
+# entries that are {label_value: count} dicts, rendered as ONE labeled
+# family each — the per-AOT-bucket occupancy histogram (`bucket` label,
+# numeric order) and the per-task serve labels (`task` label, lexical
+# order; task slugs like "unknown:<reward>" pass through label escaping).
+_SERVE_LABELED_FAMILIES = (
     (
         "bucket_batches",
         "bucket_batches_total",
         "counter",
+        "bucket",
+        _numeric_label_key,
         "Batched steps executed per AOT batch-size bucket.",
     ),
     (
         "bucket_occupancy_sum",
         "bucket_occupancy_sum",
         "counter",
+        "bucket",
+        _numeric_label_key,
         "Summed active requests per AOT bucket (mean fill = sum/batches).",
+    ),
+    (
+        "task_requests_total",
+        "task_requests_total",
+        "counter",
+        "task",
+        _lexical_label_key,
+        "Served /act requests per client-declared task tag "
+        "('unlabeled' = no tag).",
+    ),
+    (
+        "task_sessions_total",
+        "task_sessions_total",
+        "counter",
+        "task",
+        _lexical_label_key,
+        "Sessions started per client-declared task tag.",
     ),
 )
 
@@ -240,10 +274,13 @@ def _render_serve_into(
             help_text=help_text,
         )
         consumed.update({f"{key}_buckets", f"{key}_sum_s", f"{key}_count"})
-    # Per-AOT-bucket occupancy histogram (ISSUE 12 continuous batching):
-    # {bucket_size: count} dicts become one labeled family each —
-    # `rt1_serve_bucket_batches_total{bucket="4"} 17`.
-    for key, family, mtype, help_text in _SERVE_BUCKET_FAMILIES:
+    # Labeled-dict families: the per-AOT-bucket occupancy histogram
+    # (`rt1_serve_bucket_batches_total{bucket="4"} 17`, ISSUE 12) and the
+    # per-task serve labels (`rt1_serve_task_requests_total{task="play"}`,
+    # ISSUE 13) — each snapshot dict becomes one labeled family.
+    for key, family, mtype, label, sort_key, help_text in (
+        _SERVE_LABELED_FAMILIES
+    ):
         table = snapshot.get(key)
         if isinstance(table, dict):
             consumed.add(key)
@@ -252,10 +289,8 @@ def _render_serve_into(
                     prefix + family,
                     mtype,
                     [
-                        ({"bucket": str(b)}, v)
-                        for b, v in sorted(
-                            table.items(), key=lambda kv: int(kv[0])
-                        )
+                        ({label: str(b)}, v)
+                        for b, v in sorted(table.items(), key=sort_key)
                     ],
                     help_text,
                 )
@@ -342,7 +377,7 @@ def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
     names = [prefix + "replica_up", prefix + "replica_inference_dtype"]
     for key in _FLEET_REPLICA_FIELDS:
         names.append(prefix + "replica_" + _gauge_suffix(key))
-    for _, family, _, _ in _SERVE_BUCKET_FAMILIES:
+    for _, family, _, _, _, _ in _SERVE_LABELED_FAMILIES:
         names.append(prefix + "replica_" + family)
     return names
 
@@ -407,19 +442,20 @@ def render_fleet_snapshot(
             prefix + "replica_" + _gauge_suffix(key), mtype, samples,
             help_text,
         )
-    # Per-replica AOT-bucket occupancy: two labels (replica_id, bucket)
-    # per sample, so a fleet dashboard can show each replica's fill
-    # profile without scraping replicas individually.
-    for key, family, mtype, help_text in _SERVE_BUCKET_FAMILIES:
+    # Per-replica labeled-dict families: AOT-bucket occupancy
+    # ({replica_id, bucket}) and per-task serve labels ({replica_id,
+    # task}) — a fleet dashboard reads each replica's fill profile and
+    # task mix without scraping replicas individually.
+    for key, family, mtype, label, sort_key, help_text in (
+        _SERVE_LABELED_FAMILIES
+    ):
         samples = [
-            ({"replica_id": str(rid), "bucket": str(b)}, v)
+            ({"replica_id": str(rid), label: str(b)}, v)
             for rid, snap in sorted(
                 replicas.items(), key=lambda kv: str(kv[0])
             )
             if snap is not None and isinstance(snap.get(key), dict)
-            for b, v in sorted(
-                snap[key].items(), key=lambda kv: int(kv[0])
-            )
+            for b, v in sorted(snap[key].items(), key=sort_key)
         ]
         if not samples:
             continue

@@ -272,8 +272,17 @@ class MicroBatcher(_BatcherBase):
             if self._on_batch is not None:
                 self._on_batch([item for item, _ in batch])
             if self._metrics is not None:
+                # Scheduler-metric parity with ContinuousBatcher: the
+                # cycle scheduler runs exactly one batch in flight and
+                # nothing ever joins mid-cycle — emit those facts (1 and
+                # +0) explicitly so the joined_mid_cycle/in-flight
+                # dashboard families read identically under
+                # `--scheduler cycle` instead of going silent.
                 self._metrics.observe_batch(
-                    len(batch), queued=len(self._pending)
+                    len(batch),
+                    queued=len(self._pending),
+                    in_flight=1,
+                    joined_mid_cycle=0,
                 )
             items = [item for item, _ in batch]
             try:
@@ -290,6 +299,9 @@ class MicroBatcher(_BatcherBase):
                     if not future.done():
                         future.set_exception(exc)
                 continue
+            finally:
+                if self._metrics is not None:
+                    self._metrics.observe_inflight(0)
             for (_, future), result in zip(batch, results):
                 if not future.done():
                     future.set_result(result)

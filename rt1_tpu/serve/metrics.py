@@ -19,6 +19,10 @@ from typing import Any, Dict, Optional
 
 from rt1_tpu.obs.quantiles import bucket_quantile
 
+#: Task label for served requests whose client declared no `task` tag —
+#: keeps the per-task request counters summing to the served total.
+TASK_UNLABELED = "unlabeled"
+
 # Geometric-ish bucket upper bounds in seconds, 0.1 ms .. 30 s. Wide enough
 # for a tiny-CPU smoke model (sub-ms) and a cold remote-TPU dispatch alike.
 DEFAULT_BUCKETS = (
@@ -96,6 +100,13 @@ class ServeMetrics:
         self.joined_mid_cycle_total = 0
         self.bucket_batches: Dict[int, int] = {}
         self.bucket_occupancy_sum: Dict[int, int] = {}
+        # Per-task quality-observability labels (ISSUE 13): served /act
+        # requests and new sessions bucketed by the client-declared `task`
+        # tag (the same tag the flywheel capture stamps into episodes).
+        # Requests without one land in TASK_UNLABELED so the per-task
+        # counters always sum to the served-request total.
+        self.task_requests_total: Dict[str, int] = {}
+        self.task_sessions_total: Dict[str, int] = {}
         self.latency = LatencyHistogram()      # full request wall time
         self.step_latency = LatencyHistogram()  # batched device step only
 
@@ -152,6 +163,25 @@ class ServeMetrics:
             self.max_batches_in_flight = max(
                 self.max_batches_in_flight, in_flight
             )
+
+    def observe_task_request(
+        self, task: Optional[str], new_session: bool = False
+    ) -> None:
+        """One successfully served /act under workload tag `task` (None ->
+        TASK_UNLABELED); `new_session` marks the step that started a fresh
+        session window, so `task_sessions_total` counts sessions, not
+        steps. Rendered as the labeled `rt1_serve_task_*{task=...}`
+        families and aggregated fleet-wide as
+        `rt1_serve_replica_task_*{replica_id=,task=}`."""
+        key = task if isinstance(task, str) and task else TASK_UNLABELED
+        with self._lock:
+            self.task_requests_total[key] = (
+                self.task_requests_total.get(key, 0) + 1
+            )
+            if new_session:
+                self.task_sessions_total[key] = (
+                    self.task_sessions_total.get(key, 0) + 1
+                )
 
     def observe_bucket(self, bucket: int, occupancy: int) -> None:
         """One batch rode the AOT bucket of size `bucket` carrying
@@ -263,6 +293,15 @@ class ServeMetrics:
                     str(k): v
                     for k, v in sorted(self.bucket_occupancy_sum.items())
                 },
+                # Per-task serve labels, string-keyed for JSON; the
+                # Prometheus renderer emits them as labeled
+                # `rt1_serve_task_*{task="..."}` families.
+                "task_requests_total": dict(
+                    sorted(self.task_requests_total.items())
+                ),
+                "task_sessions_total": dict(
+                    sorted(self.task_sessions_total.items())
+                ),
             }
             out.update(coerced)
         return out

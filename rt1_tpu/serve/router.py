@@ -292,6 +292,15 @@ class Router:
         elapsed = time.perf_counter() - t0
         if status == 200 and "error" not in body:
             outcome = "restarted" if body.get("restarted") else "ok"
+            # Router-side per-task labels under the single-replica family
+            # names (the PR 8 convention): fleet-wide task totals on the
+            # router scrape, per-replica splits in the aggregated
+            # rt1_serve_replica_task_* families.
+            task = payload.get("task")
+            self.metrics.observe_task_request(
+                task if isinstance(task, str) else None,
+                new_session=body.get("session_started", False),
+            )
         elif status == 503:
             outcome = "rejected"
         else:

@@ -358,6 +358,13 @@ class ServeApp:
             # The engine isolates a bad item as a per-item marker so its
             # batchmates still step; surface it to THIS request only.
             raise result["error"]
+        # Per-task serve labels (rt1_serve_task_*): every successfully
+        # served step lands in exactly one task bucket (the client tag, or
+        # "unlabeled"), and the step that opened a fresh session window
+        # counts the session — independent of whether capture is on.
+        self.metrics.observe_task_request(
+            task, new_session=result.get("session_started", False)
+        )
         if self.capture is not None:
             # After the engine answered: capture sees only successfully
             # served steps, and a sink failure can never fail the request

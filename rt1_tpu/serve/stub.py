@@ -160,6 +160,13 @@ class StubReplicaApp:
         phases.t_device1 = obs_trace.now_us()
         self.metrics.observe_request(time.perf_counter() - t0)
         self.metrics.observe_batch(1, queued=0)
+        # Per-task serve labels, mimicked exactly (the real replica counts
+        # in ServeApp.act): tier-1 fleet tests prove the task-label
+        # aggregation plumbing with zero jax boots.
+        task = payload.get("task")
+        self.metrics.observe_task_request(
+            task if isinstance(task, str) else None, new_session=started
+        )
         # Smallest advertised bucket that fits a batch of 1 — the same
         # selection rule PolicyEngine.bucket_for applies.
         self.metrics.observe_bucket(

@@ -134,6 +134,14 @@ def get_config():
     # count, corpus size, and staleness. Costs one manifest read per data
     # epoch when nothing changed.
     config.data.packed_refresh = True
+    # Task-mixture sampling over the packed corpus (docs/data.md "Task
+    # mixture & per-task telemetry"): "task:weight,..." per-task sampling
+    # weights, e.g. "block2block:3,block1_to_corner:1,*:0.5" ("*" = every
+    # task not named; "unknown" matches untagged legacy episodes). Empty =
+    # off — the bit-identical pre-task uniform shuffle. Weighted epochs
+    # sample windows with replacement (p ∝ weight of the window's task),
+    # still a pure function of (seed, epoch, corpus, weights).
+    config.data.task_weights = ""
 
     # Training schedule (reference: 100 epochs x 975 steps at batch 8).
     config.per_host_batch_size = 8

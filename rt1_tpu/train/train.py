@@ -314,6 +314,23 @@ def _packed_batches(
         "packed cache: feeding %s from %s (%d windows, %dx%d packed frames)",
         split, pack_dir, len(cache), cache.packed_h, cache.packed_w,
     )
+    # Task-mixture sampling + per-task telemetry (train split only — eval
+    # streams stay the unweighted pinned corpus walk): weights come from
+    # `config.data.task_weights` ("task:weight,..." string, docs/data.md);
+    # task-id emission arms exactly when the step's health pack will
+    # consume it (model_health on, RT-1 family), so health-off runs keep a
+    # byte-identical batch stream.
+    task_weights = None
+    emit_task_ids = False
+    if split == "train":
+        from rt1_tpu import obs as obs_lib
+        from rt1_tpu.data.feeder import parse_task_weights
+
+        task_weights = parse_task_weights(config.data.get("task_weights"))
+        emit_task_ids = (
+            obs_lib.ObsOptions.from_config(config).model_health
+            and config.model.get("family", "rt1") == "rt1"
+        )
     return _build(
         SampleAheadFeeder,
         cache,
@@ -331,6 +348,8 @@ def _packed_batches(
         refresh_at_epoch=(
             split == "train" and config.data.get("packed_refresh", False)
         ),
+        task_weights=task_weights,
+        emit_task_ids=emit_task_ids,
         name="feeder_construct",
     )
 
@@ -551,7 +570,17 @@ def train_and_evaluate(config, workdir: str):
         train_iter = synthetic_batches(config, config.seed)
 
     first = next(train_iter)
-    example = (first["observations"], first["actions"])
+    # Model init must not see the feeder's per-task telemetry member — the
+    # observation contract is the model's; the task ids exist only for the
+    # jitted step's one-hot reduction (stripped there before the forward).
+    example = (
+        {
+            k: v
+            for k, v in first["observations"].items()
+            if k != obs.health.TASK_ID_KEY
+        },
+        first["actions"],
+    )
 
     tx = make_optimizer(
         learning_rate=config.learning_rate,
@@ -619,6 +648,12 @@ def train_and_evaluate(config, workdir: str):
         guard_grad_norm_max=res_opts.guard_grad_norm_max,
         model_health=obs_opts.model_health,
         health_group_depth=obs_opts.health_group_depth,
+        # Per-task telemetry: the feeder publishes its frozen task-id
+        # table when it emits task ids (packed multi-task corpora with
+        # model_health on); other sources leave the pack task-free.
+        health_task_names=tuple(
+            getattr(train_iter, "health_task_names", ()) or ()
+        ),
         plan=sharding_plan,
         mixed_precision=mixed_precision,
         check_coverage=config.model.get("family", "rt1") == "rt1",

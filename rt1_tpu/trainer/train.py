@@ -139,6 +139,7 @@ def make_train_step_fns(
     guard_grad_norm_max: float = 0.0,
     model_health: bool = False,
     health_group_depth: int = 2,
+    health_task_names: Sequence[str] = (),
     plan: Optional[planlib.ShardingPlan] = None,
     mixed_precision: bool = False,
     check_coverage: bool = True,
@@ -175,6 +176,14 @@ def make_train_step_fns(
     as ``guard_nonfinite``: a Python-level gate, so the ``False`` path
     traces the exact pre-change program (pinned bit-identical in
     tests/test_obs_health.py).
+
+    ``health_task_names`` (with ``model_health=True`` and batches whose
+    observations carry ``obs.health.TASK_ID_KEY`` — the sample-ahead
+    feeder's ``emit_task_ids``) extends the pack with per-task loss /
+    token accuracy / batch share via a one-hot segment reduction inside
+    the step (``health/task_*``). The task-id member is stripped from the
+    observations before the model forward; batches without it trace the
+    exact task-free program.
 
     Layout comes from the declarative ``plan`` (parallel/plan.py) — the same
     object train, eval, and serve resolve once from ``config.parallel``.
@@ -241,11 +250,12 @@ def make_train_step_fns(
                 _bf16_compute_copy(params), batch_stats, batch, rng, train
             )
 
+    from rt1_tpu.obs import health as health_lib
+
     health_names: Tuple[str, ...] = ()
     health_action_dims = 0
+    health_tasks: Tuple[str, ...] = ()
     if model_health:
-        from rt1_tpu.obs import health as health_lib
-
         # Action-logit statistics exist only when the default RT-1 token-CE
         # closure runs unaccumulated (the accum scan keeps only the loss;
         # family-override losses have no token logits). The pack layout is
@@ -256,11 +266,37 @@ def make_train_step_fns(
             and hasattr(model, "tokens_per_action")
         ):
             health_action_dims = int(model.tokens_per_action)
+            # Per-task loss/accuracy shares the same action-stat gate: the
+            # one-hot reduction consumes the per-example action_loss only
+            # the unaccumulated RT-1 closure exposes.
+            health_tasks = tuple(health_task_names or ())
         health_names = health_lib.pack_names(
             state.params,
             depth=health_group_depth,
             action_dims=health_action_dims,
+            task_names=health_tasks,
         )
+
+    # Strip the feeder's per-example task ids from the observations BEFORE
+    # the model forward — the model contract never includes them — and
+    # stash them into the loss aux for the health pack's per-task segment
+    # reduction. Batches without the key (synthetic, tf.data, pre-task
+    # corpora) take the untouched path: the Python-level membership check
+    # runs at trace time, so the traced program is the exact pre-task one.
+    strip_loss_fn = loss_fn
+
+    def loss_fn(params, batch_stats, batch, rng, train):  # noqa: F811
+        obs, actions = batch
+        if isinstance(obs, dict) and health_lib.TASK_ID_KEY in obs:
+            obs = dict(obs)
+            task_ids = obs.pop(health_lib.TASK_ID_KEY)
+            loss, (out, new_bs) = strip_loss_fn(
+                params, batch_stats, (obs, actions), rng, train
+            )
+            if health_tasks:
+                out = dict(out, task_ids=task_ids)
+            return loss, (out, new_bs)
+        return strip_loss_fn(params, batch_stats, batch, rng, train)
     if check_coverage:
         # The default rules are the RT-1 plan; callers training another
         # family (whose param paths the plan does not describe) pass
@@ -351,6 +387,7 @@ def make_train_step_fns(
                 out=out,
                 depth=health_group_depth,
                 action_dims=health_action_dims,
+                task_names=health_tasks,
             )
         return new_state, metrics
 

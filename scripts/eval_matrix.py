@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Closed-loop task × checkpoint eval matrix CLI (rt1_tpu/eval/matrix.py).
+
+Runs the closed-loop protocol (eval/evaluate.py) over every requested
+reward family × checkpoint cell, exposes live ``rt1_eval_*`` Prometheus
+gauges while the sweep runs, and writes one BENCH-style JSON
+(``BENCH_eval_matrix.json``) that `scripts/run_report.py` renders as a
+task × checkpoint table — the offline promotion-gate signal for the
+auto-deploy loop.
+
+  # All retained checkpoints x all nine reward families:
+  python scripts/eval_matrix.py --config rt1_tpu/train/configs/tiny.py \
+      --workdir /tmp/rt1 --episodes 3
+
+  # Two newest checkpoints, six families, live gauges on :9109, and
+  # oracle-generated corpora appended to the training pack for families
+  # the converted dataset is thin on:
+  python scripts/eval_matrix.py --config ... --workdir /tmp/rt1 \
+      --checkpoints latest:2 --tasks block2block --tasks block1_to_corner \
+      --prometheus_port 9109 \
+      --fill_pack_dir /data/lt/train_packed --fill_episodes 4
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/eval_matrix.py`
+    sys.path.insert(0, _REPO)
+
+
+def main(argv):
+    del argv
+    from absl import flags, logging
+
+    from rt1_tpu import compilation_cache
+    from rt1_tpu.eval import matrix as matrix_lib
+
+    # Same persistent-XLA-cache setup as eval/main.py: the sweep restores
+    # N checkpoints of ONE model config — every policy after the first
+    # reuses the compiled infer step.
+    compilation_cache.enable_persistent_cache()
+
+    FLAGS = flags.FLAGS
+    config = FLAGS.config
+    t0 = time.time()
+
+    tasks = tuple(FLAGS.tasks) or matrix_lib.default_task_names()
+
+    fill_summary = None
+    if FLAGS.fill_pack_dir:
+        fill_tasks = tuple(FLAGS.fill_tasks) or tasks
+        logging.info(
+            "eval_matrix: oracle-filling %s with %d episodes/task for %s",
+            FLAGS.fill_pack_dir, FLAGS.fill_episodes, fill_tasks,
+        )
+        fill_summary = matrix_lib.fill_pack(
+            FLAGS.fill_pack_dir,
+            FLAGS.fill_episodes_dir
+            or os.path.join(FLAGS.workdir, "eval_matrix_fill"),
+            fill_tasks,
+            FLAGS.fill_episodes,
+            block_mode=FLAGS.block_mode,
+            seed=FLAGS.seed,
+            max_steps=FLAGS.max_steps,
+            embedder=FLAGS.embedder,
+        )
+        logging.info("eval_matrix: fill summary %s", fill_summary)
+
+    steps = matrix_lib.checkpoint_steps(FLAGS.workdir, FLAGS.checkpoints)
+    # Lazy per-checkpoint restore: run_matrix calls each factory when its
+    # column starts, so a long `--checkpoints all` list keeps ONE restored
+    # parameter set resident instead of all of them.
+    policies = [
+        (
+            str(step),
+            (
+                lambda s=step: matrix_lib.policy_for_checkpoint(
+                    config, FLAGS.workdir, s
+                )[0]
+            ),
+        )
+        for step in steps
+    ]
+    # The history-key contract depends only on the config's family, not
+    # on any restored weights.
+    history_keys = None
+    if (
+        config.model.get("family", "rt1") == "lava"
+        and config.model.lava.lang_encoder == "clip"
+    ):
+        history_keys = (
+            "rgb_sequence", "natural_language_embedding", "instruction",
+            "effector_translation", "effector_target_translation",
+        )
+    if FLAGS.baselines:
+        from rt1_tpu.eval.evaluate import OracleEvalPolicy, RandomEvalPolicy
+
+        for name in FLAGS.baselines.split(","):
+            name = name.strip()
+            if name == "oracle":
+                policies.append((name, OracleEvalPolicy(seed=FLAGS.seed)))
+            elif name == "random":
+                policies.append((name, RandomEvalPolicy(seed=FLAGS.seed)))
+            elif name:
+                raise ValueError(f"unknown baseline {name!r}")
+    if not policies:
+        raise SystemExit(
+            f"eval_matrix: no checkpoints under {FLAGS.workdir}/checkpoints "
+            f"(spec {FLAGS.checkpoints!r}) and no --baselines"
+        )
+
+    env_kwargs = dict(
+        target_height=config.data.height,
+        target_width=config.data.width,
+        random_crop_factor=config.data.crop_factor,
+        sequence_length=config.model.time_sequence_length,
+        backend=FLAGS.backend,
+    )
+    if history_keys is not None:
+        env_kwargs["history_keys"] = history_keys
+
+    state = matrix_lib.EvalMatrixState()
+    server = None
+    if FLAGS.prometheus_port >= 0:
+        from rt1_tpu.obs import MetricsServer
+
+        server = MetricsServer(
+            state.render_prometheus, port=FLAGS.prometheus_port
+        )
+        logging.info("eval_matrix: live gauges at %s", server.url)
+
+    def progress(task, label, cell):
+        logging.info(
+            "eval_matrix: cell (%s, ckpt %s): %d/%d success, mean len %.1f",
+            task, label, cell["successes"], cell["episodes"],
+            cell["mean_episode_length"],
+        )
+
+    try:
+        matrix_lib.run_matrix(
+            policies,
+            tasks,
+            episodes_per_cell=FLAGS.episodes,
+            max_episode_steps=FLAGS.max_steps,
+            block_mode=FLAGS.block_mode,
+            seed=FLAGS.seed,
+            embedder=FLAGS.embedder,
+            env_kwargs=env_kwargs,
+            state=state,
+            progress=progress,
+        )
+    finally:
+        if server is not None:
+            server.close()
+
+    extra = {}
+    if fill_summary is not None:
+        extra["oracle_fill"] = fill_summary
+    record = matrix_lib.matrix_record(
+        state,
+        episodes_per_cell=FLAGS.episodes,
+        max_episode_steps=FLAGS.max_steps,
+        seed=FLAGS.seed,
+        embedder=FLAGS.embedder,
+        backend=FLAGS.backend,
+        block_mode=FLAGS.block_mode,
+        wall_seconds=time.time() - t0,
+        workdir=os.path.abspath(FLAGS.workdir),
+        extra=extra,
+    )
+    # Next to the checkpoints for run_report, plus wherever --out points
+    # (the repo-root BENCH series by convention).
+    written = matrix_lib.write_record(
+        record,
+        os.path.join(FLAGS.workdir, matrix_lib.BENCH_BASENAME),
+        FLAGS.out,
+    )
+    logging.info("eval_matrix: record written to %s", written)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    from absl import app, flags
+    from ml_collections import config_flags
+
+    config_flags.DEFINE_config_file("config", None, "Model/data config.")
+    flags.DEFINE_string("workdir", "/tmp/rt1_tpu", "Checkpoint directory.")
+    flags.DEFINE_string(
+        "checkpoints", "all",
+        "Which checkpoint steps to evaluate: 'all', 'latest:N', or a "
+        "comma-separated step list.")
+    flags.DEFINE_multi_string(
+        "tasks", [],
+        "Reward families to evaluate (repeatable); default: every "
+        "canonical family.")
+    flags.DEFINE_integer("episodes", 3, "Episodes per (task, ckpt) cell.")
+    flags.DEFINE_integer("max_steps", 80, "Max steps per episode.")
+    flags.DEFINE_string("block_mode", "BLOCK_8", "Block variant.")
+    flags.DEFINE_integer("seed", 0, "Env seed.")
+    flags.DEFINE_string("embedder", "hash", "Instruction embedder spec.")
+    flags.DEFINE_string(
+        "backend", "kinematic",
+        "Physics backend: kinematic | kinematic_arm | auto.")
+    flags.DEFINE_string(
+        "baselines", "",
+        "Extra policy columns next to the checkpoints: comma subset of "
+        "'oracle,random' (the protocol ceiling and chance floor).")
+    flags.DEFINE_integer(
+        "prometheus_port", -1,
+        ">= 0: serve live rt1_eval_* gauges on this port during the sweep "
+        "(0 = ephemeral, logged at startup); < 0: off.")
+    flags.DEFINE_string(
+        "out", "",
+        "Extra path for the BENCH record (a copy always lands at "
+        "<workdir>/BENCH_eval_matrix.json).")
+    flags.DEFINE_string(
+        "fill_pack_dir", "",
+        "Existing packed-cache dir to append oracle-generated per-task "
+        "corpora to (the PR 10 append_shard path) before the sweep.")
+    flags.DEFINE_multi_string(
+        "fill_tasks", [],
+        "Families to oracle-fill (default: the sweep's --tasks).")
+    flags.DEFINE_integer(
+        "fill_episodes", 4, "Oracle episodes to collect per filled task.")
+    flags.DEFINE_string(
+        "fill_episodes_dir", "",
+        "Where the oracle-generated episode files land (default "
+        "<workdir>/eval_matrix_fill).")
+    flags.mark_flags_as_required(["config"])
+    app.run(main)

@@ -10,8 +10,10 @@ model-health gauges, `timing/*` buckets). A serving/chaos run adds its
 own artifacts — ``slo_summary.json`` (the SLO ledger's judgement),
 ``BENCH_serve_fleet.json`` (the loadgen record, incl. per-replica fleet
 metrics), ``slow_requests.jsonl`` (the slow-request exemplar ring) — and
-those render as a serve post-mortem section. This script merges them
-into one human-readable report::
+those render as a serve post-mortem section. An eval-matrix sweep
+(``scripts/eval_matrix.py``) leaves ``BENCH_eval_matrix.json``, rendered
+as a task × checkpoint success table. This script merges them into one
+human-readable report::
 
     python scripts/run_report.py --workdir /tmp/run            # stdout
     python scripts/run_report.py --workdir /tmp/run --out report.md
@@ -108,6 +110,19 @@ def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
         except OSError:
             pass
     return out or None
+
+
+def load_eval_matrix(workdir: str) -> Optional[Dict[str, Any]]:
+    """The task × checkpoint eval-matrix record (scripts/eval_matrix.py),
+    or None when the workdir has never run a sweep."""
+    path = os.path.join(workdir, "BENCH_eval_matrix.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None  # half-written record from a killed sweep
 
 
 def load_tb_scalars(workdir: str) -> Optional[Dict[str, Tuple[int, float]]]:
@@ -303,6 +318,59 @@ def render_scalars(
     return lines
 
 
+def render_eval_matrix(record: Optional[Dict[str, Any]]) -> List[str]:
+    """The model-quality section: closed-loop success per task ×
+    checkpoint cell as one table — the matrix the promotion gate reads."""
+    lines = ["## Eval matrix (task × checkpoint success)", ""]
+    if record is None:
+        lines.append(
+            "BENCH_eval_matrix.json not found — no eval-matrix sweep has "
+            "run against this workdir (scripts/eval_matrix.py)."
+        )
+        return lines
+    checkpoints = record.get("checkpoints", [])
+    matrix = record.get("matrix", {})
+    if not checkpoints or not matrix:
+        lines.append("Record present but empty (sweep died before a cell).")
+        return lines
+    lines.append(
+        f"{len(matrix)} task(s) × {len(checkpoints)} checkpoint(s), "
+        f"{record.get('episodes_per_cell', '?')} episodes/cell, "
+        f"max {record.get('max_episode_steps', '?')} steps, backend "
+        f"{record.get('backend', '?')!r}; headline mean cell success "
+        f"{record.get('value', 0.0):.3f}."
+    )
+    lines.append("")
+    col_w = max(14, max(len(f"ckpt {c}") for c in checkpoints) + 2)
+    header = f"{'task':<30}" + "".join(
+        f"{('ckpt ' + str(c)):>{col_w}}" for c in checkpoints
+    )
+    lines.append(header)
+    for task in sorted(matrix):
+        row = matrix[task]
+        cells = []
+        for ckpt in checkpoints:
+            cell = row.get(str(ckpt)) or row.get(ckpt)
+            if not cell or not cell.get("episodes"):
+                cells.append(f"{'-':>{col_w}}")
+            else:
+                cells.append(
+                    f"{cell['successes']}/{cell['episodes']}"
+                    f" ({cell['success_rate']:.2f})".rjust(col_w)
+                )
+        lines.append(f"{task:<30}" + "".join(cells))
+    fill = record.get("oracle_fill")
+    if fill:
+        lines.append("")
+        lines.append(
+            f"Oracle corpus fill: {fill.get('episodes_appended', 0)} "
+            f"episodes appended ({fill.get('episodes_per_task')}), pack "
+            f"now {fill.get('shards_after', '?')} shard(s) at freshness "
+            f"epoch {fill.get('freshness_epoch', '?')}."
+        )
+    return lines
+
+
 def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     """The serve post-mortem: SLO verdict, per-class outcome table,
     fleet/chaos evidence from the BENCH record, slowest exemplars."""
@@ -456,6 +524,7 @@ def render_report(
     tb: Optional[Dict[str, Tuple[int, float]]],
     tail: int = 8,
     serve: Optional[Dict[str, Any]] = None,
+    eval_matrix: Optional[Dict[str, Any]] = None,
 ) -> str:
     sections = [
         [f"# RT-1 run report — {workdir}", ""],
@@ -468,8 +537,12 @@ def render_report(
         render_scalars(tb),
         [""],
     ]
-    # Serve section only when a serving artifact exists: a training-only
-    # workdir keeps its report unchanged (and its golden tests green).
+    # Serve / eval-matrix sections only when their artifacts exist: a
+    # training-only workdir keeps its report unchanged (and its golden
+    # tests green).
+    if eval_matrix is not None:
+        sections.insert(1, [""])
+        sections.insert(1, render_eval_matrix(eval_matrix))
     if serve is not None:
         sections.insert(1, [""])
         sections.insert(1, render_serve(serve, tail=tail))
@@ -492,6 +565,7 @@ def main(argv=None):
         load_tb_scalars(args.workdir),
         tail=args.tail,
         serve=load_serve(args.workdir),
+        eval_matrix=load_eval_matrix(args.workdir),
     )
     if args.out:
         with open(args.out, "w") as f:

@@ -137,6 +137,78 @@ def test_full_protocol_tiny(tiny_policy_setup):
     assert results["episodes_per_reward"] == 2
 
 
+def test_eval_matrix_sweep_and_record(tiny_policy_setup, tmp_path):
+    """ISSUE 13 tentpole: run_matrix sweeps (policy × task) cells through
+    the closed-loop protocol, the state renders live rt1_eval_* gauges
+    mid-sweep, and matrix_record emits the BENCH shape run_report reads."""
+    from rt1_tpu.eval import matrix as matrix_lib
+
+    model, variables = tiny_policy_setup
+    policy = RT1EvalPolicy(model, variables)
+    seen = []
+    state = matrix_lib.run_matrix(
+        [("42", policy)],
+        ("block2block", "block1_to_corner"),
+        episodes_per_cell=1,
+        max_episode_steps=4,
+        block_mode="BLOCK_4",
+        seed=0,
+        env_kwargs=dict(
+            target_height=64, target_width=114, sequence_length=3
+        ),
+        progress=lambda task, label, cell: seen.append((task, label)),
+    )
+    assert seen == [("block2block", "42"), ("block1_to_corner", "42")]
+    matrix = state.matrix()
+    assert set(matrix) == {"block2block", "block1_to_corner"}
+    for row in matrix.values():
+        cell = row["42"]
+        assert cell["episodes"] == 1
+        assert 0.0 <= cell["success_rate"] <= 1.0
+    text = state.render_prometheus()
+    assert 'rt1_eval_episodes_total{task="block2block",checkpoint="42"} 1' in text
+    record = matrix_lib.matrix_record(
+        state,
+        episodes_per_cell=1,
+        max_episode_steps=4,
+        seed=0,
+        embedder="hash",
+        backend="kinematic",
+        block_mode="BLOCK_4",
+        wall_seconds=1.0,
+    )
+    assert record["bench"] == "eval_matrix"
+    assert record["checkpoints"] == ["42"]
+    assert set(record["tasks"]) == {"block2block", "block1_to_corner"}
+    out = str(tmp_path / "BENCH_eval_matrix.json")
+    assert matrix_lib.write_record(record, out, "") == [out]
+    import json
+
+    with open(out) as f:
+        assert json.load(f)["bench"] == "eval_matrix"
+
+
+def test_eval_matrix_checkpoint_steps(tmp_path):
+    """checkpoint_steps resolves 'all' / 'latest:N' / explicit lists from
+    the on-disk step dirs, skipping Orbax tmp dirs and torn mkdirs."""
+    from rt1_tpu.eval.matrix import checkpoint_steps
+
+    ckpts = tmp_path / "run" / "checkpoints"
+    for step in (2, 4, 10):
+        d = ckpts / str(step)
+        d.mkdir(parents=True)
+        (d / "payload").write_text("x")
+    (ckpts / "7.orbax-checkpoint-tmp-123").mkdir()  # in-flight write
+    (ckpts / "9").mkdir()  # torn mkdir: empty, not a checkpoint
+    wd = str(tmp_path / "run")
+    assert checkpoint_steps(wd) == [2, 4, 10]
+    assert checkpoint_steps(wd, "latest:2") == [4, 10]
+    assert checkpoint_steps(wd, "4,2") == [2, 4]
+    with pytest.raises(ValueError, match="not found"):
+        checkpoint_steps(wd, "3")
+    assert checkpoint_steps(str(tmp_path / "nowhere")) == []
+
+
 def test_oracle_eval_policy_protocol():
     """The privileged expert baseline under the standard protocol: bind_env
     wiring, lazy per-episode planning, and a sanity bar — the RRT oracle

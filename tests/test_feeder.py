@@ -202,6 +202,218 @@ def test_feeder_matches_numpy_loader_without_augmentation(corpus, cache_nocrop):
         _batches_equal(a, b)
 
 
+# ------------------------------------------------- task mixture (ISSUE 13)
+
+
+@pytest.fixture(scope="module")
+def tagged_cache(tmp_path_factory):
+    """Packed corpus with per-episode task tags: 2x 'block2block' +
+    2x 'corner' episodes, 6 steps each."""
+    tmp = tmp_path_factory.mktemp("tagged_corpus")
+    rng = np.random.default_rng(3)
+    paths = []
+    for i, task in enumerate(
+        ("block2block", "block2block", "corner", "corner")
+    ):
+        ep = ep_lib.generate_synthetic_episode(
+            rng, num_steps=6, height=SRC_H, width=SRC_W
+        )
+        ep["task"] = ep_lib.encode_instruction_text(task)
+        p = str(tmp / f"episode_{i}.npz")
+        ep_lib.save_episode(p, ep)
+        paths.append(p)
+    out = str(tmp_path_factory.mktemp("tagged_packed"))
+    pack_lib.pack_episodes(paths, out, H, W, 0.95)
+    return pack_lib.PackedEpisodeCache(out, window=WINDOW)
+
+
+def test_parse_task_weights():
+    from rt1_tpu.data.feeder import parse_task_weights
+
+    assert parse_task_weights(None) is None
+    assert parse_task_weights("") is None
+    assert parse_task_weights("a:3,b:1") == {"a": 3.0, "b": 1.0}
+    # Task slugs may contain ':' — the weight is after the LAST colon.
+    assert parse_task_weights("unknown:mystery:2") == {
+        "unknown:mystery": 2.0
+    }
+    assert parse_task_weights({"a": 1}) == {"a": 1.0}
+    with pytest.raises(ValueError, match="not a number"):
+        parse_task_weights("a:x")
+    with pytest.raises(ValueError, match="no positive weight"):
+        parse_task_weights("a:0,b:0")
+    with pytest.raises(ValueError, match=">= 0"):
+        parse_task_weights("a:-1")
+
+
+def test_task_weights_none_is_pre_pr_stream(cache):
+    """weights=None must be the EXACT pre-task order draw: the legacy
+    (seed, epoch)-keyed permutation, bit-identical — and a feeder built
+    with an explicit None matches one that never heard of the kwarg."""
+    with SampleAheadFeeder(
+        cache, 4, seed=11, num_epochs=1, task_weights=None
+    ) as f:
+        got = list(f)
+    with SampleAheadFeeder(cache, 4, seed=11, num_epochs=1) as g:
+        want = list(g)
+    for a, b in zip(got, want):
+        _batches_equal(a, b)
+    assert "task_id" not in got[0]["observations"]
+    # The order formula itself is the pinned pre-PR one.
+    order = g._compute_order(0, len(cache))
+    legacy = np.arange(len(cache))
+    np.random.default_rng([11, 0]).shuffle(legacy)
+    np.testing.assert_array_equal(order, legacy)
+
+
+def test_task_weights_deterministic_across_threads(tagged_cache):
+    """Same (seed, epoch, corpus, weights) -> byte-identical stream
+    (images, labels, AND task ids) regardless of worker thread count."""
+    streams = []
+    for n_threads in (1, 3):
+        with SampleAheadFeeder(
+            tagged_cache, 4, seed=5, num_epochs=2, num_threads=n_threads,
+            task_weights={"block2block": 3, "corner": 1},
+            emit_task_ids=True,
+        ) as f:
+            streams.append(list(f))
+    assert len(streams[0]) == len(streams[1]) > 0
+    for a, b in zip(*streams):
+        _batches_equal(a, b)
+        np.testing.assert_array_equal(
+            a["observations"]["task_id"], b["observations"]["task_id"]
+        )
+
+
+def test_task_weights_change_the_stream_key(tagged_cache):
+    """Different weights -> a different (reproducible) order; the weights
+    digest is folded into the shuffle key."""
+    f1 = SampleAheadFeeder(
+        tagged_cache, 4, seed=5, start=False,
+        task_weights={"block2block": 3, "corner": 1},
+    )
+    f2 = SampleAheadFeeder(
+        tagged_cache, 4, seed=5, start=False,
+        task_weights={"block2block": 1, "corner": 3},
+    )
+    o1 = f1._compute_order(0, len(tagged_cache))
+    o2 = f2._compute_order(0, len(tagged_cache))
+    assert not np.array_equal(o1, o2)
+    # Same weights -> same order (pure function, no feeder state).
+    f3 = SampleAheadFeeder(
+        tagged_cache, 4, seed=5, start=False,
+        task_weights={"block2block": 3, "corner": 1},
+    )
+    np.testing.assert_array_equal(
+        o1, f3._compute_order(0, len(tagged_cache))
+    )
+
+
+def test_task_weights_empirical_mixture_frequency(tagged_cache):
+    """A 3:1 weighted mixture's empirical task frequencies land within
+    tolerance of 0.75/0.25 over a few epochs (each task owns half the
+    corpus windows, so the uniform draw would give 0.5/0.5)."""
+    with SampleAheadFeeder(
+        tagged_cache, 4, seed=9, num_epochs=4,
+        task_weights={"block2block": 3, "corner": 1},
+        emit_task_ids=True,
+    ) as f:
+        names = f.health_task_names
+        counts = np.zeros(len(names), np.int64)
+        for batch in f:
+            tid = batch["observations"]["task_id"]
+            assert tid.dtype == np.int32 and tid.shape == (4,)
+            counts += np.bincount(tid, minlength=len(names))
+    freq = counts / counts.sum()
+    by_name = dict(zip(names, freq))
+    assert names == ("block2block", "corner", "other")
+    assert abs(by_name["block2block"] - 0.75) < 0.12
+    assert abs(by_name["corner"] - 0.25) < 0.12
+    assert by_name["other"] == 0.0
+
+
+def test_task_weights_wildcard_and_unmatched(tagged_cache):
+    """'*' weights every unnamed task; weights matching no corpus task
+    raise loudly at order-draw time instead of feeding an empty epoch."""
+    f = SampleAheadFeeder(
+        tagged_cache, 4, seed=0, start=False,
+        task_weights={"corner": 1, "*": 0.0},
+    )
+    order = f._compute_order(0, len(tagged_cache))
+    # Only corner windows (episodes 2-3 -> windows 12..23) can be drawn.
+    assert set(np.asarray(order) // 6) <= {2, 3}
+    with pytest.raises(ValueError, match="zero total weight"):
+        SampleAheadFeeder(
+            tagged_cache, 4, seed=0, start=False,
+            task_weights={"zebra": 1.0},
+        )
+
+
+def test_task_weights_require_shuffle(tagged_cache):
+    with pytest.raises(ValueError, match="shuffle"):
+        SampleAheadFeeder(
+            tagged_cache, 4, seed=0, shuffle=False, start=False,
+            task_weights={"corner": 1},
+        )
+
+
+def test_emit_task_ids_member_and_names(tagged_cache, cache):
+    """emit_task_ids adds ONE (batch,) int32 member whose ids index the
+    frozen health_task_names table (sorted unique tasks + 'other');
+    untagged corpora map every window to 'unknown'."""
+    with SampleAheadFeeder(
+        tagged_cache, 4, seed=2, num_epochs=1, emit_task_ids=True
+    ) as f:
+        assert f.health_task_names == ("block2block", "corner", "other")
+        batch = next(f)
+        tid = batch["observations"]["task_id"]
+        order = f._epoch_order(0)
+        for j, idx in enumerate(order[:4]):
+            task = tagged_cache.episode_task(
+                tagged_cache.index[int(idx)][0]
+            )
+            assert f.health_task_names[tid[j]] == task
+    # Untagged corpus: every episode reports the UNKNOWN_TASK slug.
+    with SampleAheadFeeder(
+        cache, 4, seed=2, num_epochs=1, emit_task_ids=True
+    ) as g:
+        assert g.health_task_names == ("unknown", "other")
+        assert set(next(g)["observations"]["task_id"]) == {0}
+    # Off (the default): no member, pre-PR batch structure.
+    with SampleAheadFeeder(cache, 4, seed=2, num_epochs=1) as h:
+        assert h.health_task_names == ()
+        assert "task_id" not in next(h)["observations"]
+
+
+def test_emit_task_ids_literal_other_task_no_duplicate(tmp_path_factory):
+    """A corpus whose episodes are literally tagged 'other' must not
+    produce a duplicate name in the frozen id table — the real task and
+    the overflow bucket share the one 'other' entry."""
+    tmp = tmp_path_factory.mktemp("other_corpus")
+    rng = np.random.default_rng(4)
+    paths = []
+    for i, task in enumerate(("other", "corner")):
+        ep = ep_lib.generate_synthetic_episode(
+            rng, num_steps=6, height=SRC_H, width=SRC_W
+        )
+        ep["task"] = ep_lib.encode_instruction_text(task)
+        p = str(tmp / f"episode_{i}.npz")
+        ep_lib.save_episode(p, ep)
+        paths.append(p)
+    out = str(tmp_path_factory.mktemp("other_packed"))
+    pack_lib.pack_episodes(paths, out, H, W, None)
+    cache = pack_lib.PackedEpisodeCache(out, window=WINDOW)
+    with SampleAheadFeeder(
+        cache, 4, seed=0, num_epochs=1, emit_task_ids=True
+    ) as f:
+        names = f.health_task_names
+        assert names == ("corner", "other")
+        assert len(names) == len(set(names))
+        batch = next(f)
+        tid = batch["observations"]["task_id"]
+        assert set(tid) <= set(range(len(names)))
+
+
 def test_train_dataset_batches_packed_switch(tmp_path, corpus):
     """train.dataset_batches honors data.packed_cache: fresh cache feeds
     through the feeder; missing cache falls back to the tf.data path."""
@@ -248,4 +460,25 @@ def test_train_dataset_batches_packed_switch(tmp_path, corpus):
         config.data.width,
         3,
     )
+    # tiny config ships model_health off -> no task-id member, no
+    # mixture: the pre-task stream byte-for-byte.
+    assert "task_id" not in batch["observations"]
+    assert it.task_weights is None and not it.emit_task_ids
+    it.close()
+
+    # With model_health on, the train feeder arms per-task telemetry and
+    # honors config.data.task_weights ("task:weight,..." string).
+    with config.unlocked():
+        config.obs.model_health = True
+        config.data.task_weights = "unknown:2"
+    it = dataset_batches(config, "train")
+    assert isinstance(it, SampleAheadFeeder)
+    assert it.emit_task_ids
+    # This corpus is untagged -> one real task ("unknown") + overflow.
+    assert it.health_task_names == ("unknown", "other")
+    assert it.task_weights == {"unknown": 2.0}
+    batch = next(it)
+    tid = batch["observations"]["task_id"]
+    assert tid.shape == (2,) and tid.dtype == np.int32
+    assert set(tid) == {0}
     it.close()

@@ -93,7 +93,11 @@ def test_legacy_v2_manifest_loads_byte_identical(base_pack, tmp_path):
     cache_v2 = pack_lib.PackedEpisodeCache(out, window=WINDOW)
     assert cache_v2.num_shards == 1
     assert cache_v2.freshness_epoch == 0
-    assert cache_v2.episode_task(0) is None  # legacy manifests carry none
+    # Legacy manifests carry no task metas: the cache reports the stable
+    # "unknown" slug (never None, never raises) so mixture weights and
+    # per-task telemetry always see a string id.
+    assert cache_v2.episode_task(0) == "unknown"
+    assert set(cache_v2.tasks) == {"unknown"}
     for idx, w in zip((0, 7), want):
         got = cache_v2.get_window(idx, np.random.default_rng(idx))
         np.testing.assert_array_equal(
@@ -103,6 +107,23 @@ def test_legacy_v2_manifest_loads_byte_identical(base_pack, tmp_path):
             got["actions"]["action"], w["actions"]["action"]
         )
     assert pack_lib.pack_is_fresh(out, paths, H, W, 0.95)
+
+
+def test_canonical_task_id_slugs():
+    """ISSUE 13 satellite: collect.py's task-stamping authority maps
+    canonical reward families through unchanged and everything else to a
+    stable 'unknown:<name>' slug — never silently dropping the tag."""
+    from rt1_tpu.data.collect import UNKNOWN_TASK, canonical_task_id
+
+    assert canonical_task_id("block2block") == "block2block"
+    assert canonical_task_id("block1_to_corner") == "block1_to_corner"
+    assert canonical_task_id("my_custom_reward") == "unknown:my_custom_reward"
+    assert canonical_task_id("") == UNKNOWN_TASK
+    assert canonical_task_id(None) == UNKNOWN_TASK
+    # The slug round-trips through the pack manifest and feeder weight
+    # lookups verbatim (':' is legal in exposition label values and
+    # metric names — pinned in test_obs_prometheus).
+    assert canonical_task_id("x:y") == "unknown:x:y"
 
 
 def test_unknown_format_version_rejected(base_pack):

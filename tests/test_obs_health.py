@@ -21,7 +21,7 @@ from rt1_tpu.obs import health
 from test_rt1 import make_batch, tiny_policy
 
 
-def _setup(model_health, donate=True, guard=False):
+def _setup(model_health, donate=True, guard=False, task_names=()):
     from rt1_tpu.parallel import MeshConfig, make_mesh
     from rt1_tpu.trainer import (
         create_train_state,
@@ -37,7 +37,7 @@ def _setup(model_health, donate=True, guard=False):
     mesh = make_mesh(MeshConfig())
     fns = make_train_step_fns(
         model, mesh, state, model_health=model_health, donate=donate,
-        guard_nonfinite=guard,
+        guard_nonfinite=guard, health_task_names=task_names,
     )
     return fns, fns.shard_state(state), (obs, actions)
 
@@ -133,6 +133,79 @@ def test_health_off_step_is_bit_identical():
         np.testing.assert_array_equal(a, b)
 
 
+def test_health_pack_per_task_segment_reduction():
+    """ISSUE 13: with health_task_names and a batch carrying TASK_ID_KEY,
+    the pack gains task_loss/task_acc/task_frac per task, computed by the
+    in-step one-hot reduction. Invariants: fracs sum to 1, a task absent
+    from the batch reports 0/0/0, and the frac-weighted per-task loss and
+    accuracy reproduce the batch-level loss / mean token accuracy."""
+    names = ("block2block", "corner", "other")
+    fns, state, (obs, actions) = _setup(
+        model_health=True, donate=False, task_names=names
+    )
+    for suffix in ("loss", "acc", "frac"):
+        for t in names:
+            assert f"health/task_{suffix}/{t}" in fns.health_names
+    # 5 block2block rows, 3 corner rows, nobody in 'other'.
+    task_ids = np.array([0, 0, 0, 0, 0, 1, 1, 1], np.int32)
+    obs = dict(obs, task_id=task_ids)
+    state, metrics = fns.train_step(
+        state, fns.shard_batch((obs, actions)), jax.random.PRNGKey(1)
+    )
+    scalars = health.unpack(
+        fns.health_names, np.asarray(metrics[health.PACK_KEY])
+    )
+    fracs = {t: scalars[f"health/task_frac/{t}"] for t in names}
+    assert fracs["block2block"] == pytest.approx(5 / 8)
+    assert fracs["corner"] == pytest.approx(3 / 8)
+    assert fracs["other"] == 0.0
+    assert scalars["health/task_loss/other"] == 0.0
+    assert scalars["health/task_acc/other"] == 0.0
+    # Weighted recomposition: sum_k frac_k * task_loss_k == batch loss,
+    # and likewise for token accuracy (mean of the per-dim entries).
+    recomposed_loss = sum(
+        fracs[t] * scalars[f"health/task_loss/{t}"] for t in names
+    )
+    assert recomposed_loss == pytest.approx(float(metrics["loss"]), rel=1e-5)
+    dim_accs = [
+        v for n, v in scalars.items() if n.startswith("health/token_acc/")
+    ]
+    recomposed_acc = sum(
+        fracs[t] * scalars[f"health/task_acc/{t}"] for t in names
+    )
+    assert recomposed_acc == pytest.approx(
+        float(np.mean(dim_accs)), rel=1e-5, abs=1e-6
+    )
+
+
+def test_task_ids_stripped_before_model():
+    """A batch carrying task ids must produce the exact same update as
+    the same batch without them — the step strips TASK_ID_KEY before the
+    model forward, so the observation contract is untouched."""
+    fns_plain, state_plain, (obs, actions) = _setup(
+        model_health=True, donate=False
+    )
+    fns_task, state_task, _ = _setup(
+        model_health=True, donate=False, task_names=("a", "b")
+    )
+    rng = jax.random.PRNGKey(3)
+    obs_tagged = dict(
+        obs, task_id=np.zeros((obs["image"].shape[0],), np.int32)
+    )
+    state_plain, m_plain = fns_plain.train_step(
+        state_plain, fns_plain.shard_batch((obs, actions)), rng
+    )
+    state_task, m_task = fns_task.train_step(
+        state_task, fns_task.shard_batch((obs_tagged, actions)), rng
+    )
+    assert float(m_plain["loss"]) == float(m_task["loss"])
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state_plain.params)),
+        jax.tree.leaves(jax.device_get(state_task.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_health_composes_with_guard():
     from rt1_tpu.resilience import faults
 
@@ -160,6 +233,124 @@ def test_health_composes_with_guard():
 
 
 # ----------------------------------------------------------- loop e2e
+
+
+@pytest.mark.slow
+def test_train_loop_emits_per_task_health_live(tmp_path):
+    """ISSUE 13 acceptance shape: a live tiny train run over a packed
+    MULTI-task corpus with model_health on emits health/task_* scalars to
+    TB and rt1_train_health_task_* gauges on a live Prometheus scrape,
+    with the task mixture weighted by config.data.task_weights."""
+    import json
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from rt1_tpu.data import episodes as ep_lib
+    from rt1_tpu.data import pack as pack_lib
+
+    # 6 episodes, two tagged families + untagged, at tiny geometry.
+    src = tmp_path / "store" / "train"
+    src.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    paths = []
+    for i, task in enumerate(
+        ("block2block", "block2block", "block2block",
+         "block1_to_corner", "block1_to_corner", None)
+    ):
+        ep = ep_lib.generate_synthetic_episode(
+            rng, num_steps=8, height=32, width=56
+        )
+        if task:
+            ep["task"] = ep_lib.encode_instruction_text(task)
+        p = str(src / f"episode_{i}.npz")
+        ep_lib.save_episode(p, ep)
+        paths.append(p)
+    pack_lib.pack_episodes(
+        paths, str(tmp_path / "store" / "train_packed"), 32, 56, 0.95
+    )
+
+    workdir = str(tmp_path / "run")
+    port = 19137
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "rt1_tpu.train.train",
+            "--config", "rt1_tpu/train/configs/tiny.py",
+            "--workdir", workdir,
+            "--config.data.data_dir", str(tmp_path / "store"),
+            "--config.data.packed_cache=True",
+            "--config.data.task_weights=block2block:2,block1_to_corner:1,"
+            "unknown:1",
+            "--config.obs.model_health=True",
+            f"--config.obs.prometheus_port={port}",
+            "--config.num_steps=25",
+            "--config.log_every_steps=5",
+            "--config.eval_every_steps=0",
+        ],
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    scrape = None
+    try:
+        deadline = time.time() + 600
+        while proc.poll() is None and time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as resp:
+                    body = resp.read().decode("utf-8")
+                if "rt1_train_health_task_loss_block2block" in body:
+                    scrape = body
+                    break
+            except OSError:
+                pass
+            time.sleep(1.0)
+        out, _ = proc.communicate(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-4000:]
+    assert scrape is not None, (
+        "no live scrape carried per-task health gauges\n" + out[-4000:]
+    )
+    for name in (
+        "rt1_train_health_task_loss_block2block",
+        "rt1_train_health_task_acc_block2block",
+        "rt1_train_health_task_frac_block2block",
+        "rt1_train_health_task_loss_block1_to_corner",
+        "rt1_train_health_task_frac_unknown",
+        "rt1_train_health_task_frac_other",
+    ):
+        assert name in scrape, name
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "scripts")
+    )
+    import run_report
+
+    tb = run_report.load_tb_scalars(workdir)
+    assert tb is not None
+    assert "health/task_loss/block2block" in tb
+    assert "health/task_acc/block1_to_corner" in tb
+    assert "health/task_frac/unknown" in tb
+    # The weighted mixture shows in the emitted fracs: block2block got
+    # weight 2 of 4 over half the corpus windows — its frac should beat
+    # the unweighted 0.5 corpus share... at least be the plurality.
+    fracs = {
+        t: v for t, (_, v) in tb.items()
+        if t.startswith("health/task_frac/")
+    }
+    assert json.dumps(fracs)  # JSON-clean
+    assert fracs["health/task_frac/block2block"] >= max(
+        fracs["health/task_frac/block1_to_corner"],
+        fracs["health/task_frac/unknown"],
+    )
 
 
 @pytest.mark.slow

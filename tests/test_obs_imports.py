@@ -241,6 +241,33 @@ from rt1_tpu.obs.prometheus import render_scalar_gauges
 assert "rt1_flywheel_shards 2" in render_scalar_gauges(
     {"shards": 2}, prefix="rt1_flywheel_")
 
+# ISSUE 13 quality-observability plane: the eval-matrix sweep driver is
+# import-light by contract (a serve-side promotion controller runs it),
+# and the per-task serve labels render through the same snapshot->text
+# path — all clu/TF-free.
+from rt1_tpu.eval.matrix import EvalMatrixState, checkpoint_steps
+
+st = EvalMatrixState()
+st.note_cell("block1_to_corner", "100", 1, 2, 3.0)
+mtext = st.render_prometheus()
+assert (
+    'rt1_eval_success{task="block1_to_corner",checkpoint="100"} 0.5'
+    in mtext
+)
+assert (
+    'rt1_eval_episodes_total{task="block1_to_corner",checkpoint="100"} 2'
+    in mtext
+)
+assert checkpoint_steps("/nonexistent/workdir") == []
+
+mt = ServeMetrics()
+mt.observe_task_request("unknown:probe", new_session=True)
+mt.observe_task_request(None)
+ttext = mt.prometheus_text()
+assert 'rt1_serve_task_requests_total{task="unknown:probe"} 1' in ttext
+assert 'rt1_serve_task_requests_total{task="unlabeled"} 1' in ttext
+assert 'rt1_serve_task_sessions_total{task="unknown:probe"} 1' in ttext
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

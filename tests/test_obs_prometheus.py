@@ -332,6 +332,10 @@ def test_fleet_metric_names_all_renderable():
     # (ISSUE 12), labeled {replica_id, bucket}.
     full["bucket_batches"] = {"1": 3, "4": 2}
     full["bucket_occupancy_sum"] = {"1": 3, "4": 7}
+    # The per-task serve labels render from the task dicts (ISSUE 13),
+    # labeled {replica_id, task}.
+    full["task_requests_total"] = {"block2block": 5, "unlabeled": 1}
+    full["task_sessions_total"] = {"block2block": 2}
     text = prom.render_fleet_snapshot({}, {0: full})
     types, _ = parse_exposition(text)
     for name in names:
@@ -405,6 +409,184 @@ def test_fleet_mixed_dtype_labeled_families():
     assert "rt1_serve_replica_inference_dtype" in names
     assert "rt1_serve_replica_param_bytes_device" in names
     assert "rt1_serve_replica_param_bytes_master" in names
+
+
+def test_task_label_families_render():
+    """ISSUE 13 naming contract: per-task serve labels render as labeled
+    `rt1_serve_task_*{task="..."}` families through the one snapshot→text
+    path — task slugs containing ':' ("unknown:<reward>") survive label
+    escaping — and the fleet aggregation emits the
+    `rt1_serve_replica_task_*{replica_id=,task=}` variants."""
+    metrics = ServeMetrics()
+    metrics.observe_task_request("block2block", new_session=True)
+    metrics.observe_task_request("block2block")
+    metrics.observe_task_request("unknown:block2tower", new_session=True)
+    metrics.observe_task_request(None)  # no client tag -> "unlabeled"
+
+    snap = metrics.snapshot()
+    assert snap["task_requests_total"] == {
+        "block2block": 2,
+        "unknown:block2tower": 1,
+        "unlabeled": 1,
+    }
+    assert snap["task_sessions_total"] == {
+        "block2block": 1,
+        "unknown:block2tower": 1,
+    }
+
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_task_requests_total"] == "counter"
+    assert types["rt1_serve_task_sessions_total"] == "counter"
+    reqs = {
+        labels["task"]: int(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_task_requests_total"
+    }
+    assert reqs == {
+        "block2block": 2,
+        "unknown:block2tower": 1,
+        "unlabeled": 1,
+    }
+    assert (
+        "rt1_serve_task_sessions_total",
+        {"task": "unknown:block2tower"},
+        "1",
+    ) in samples
+
+    # Fleet variants: {replica_id, task} double label + the scrape-config
+    # contract names both families.
+    fleet_text = prom.render_fleet_snapshot({}, {2: snap})
+    _, fleet_samples = parse_exposition(fleet_text)
+    assert (
+        "rt1_serve_replica_task_requests_total",
+        {"replica_id": "2", "task": "block2block"},
+        "2",
+    ) in fleet_samples
+    assert (
+        "rt1_serve_replica_task_sessions_total",
+        {"replica_id": "2", "task": "unknown:block2tower"},
+        "1",
+    ) in fleet_samples
+    names = prom.fleet_metric_names()
+    assert "rt1_serve_replica_task_requests_total" in names
+    assert "rt1_serve_replica_task_sessions_total" in names
+
+    # No task traffic yet: no empty family headers.
+    empty_text = prom.render_serve_snapshot(ServeMetrics().snapshot())
+    assert "rt1_serve_task_requests_total" not in empty_text
+
+
+def test_stub_counts_task_requests():
+    """The jax-free stub replica speaks the task-label contract: tagged
+    /act payloads land in the per-task counters exactly like the real
+    ServeApp, so fleet tests prove aggregation without a model."""
+    from rt1_tpu.serve.stub import StubReplicaApp
+
+    stub = StubReplicaApp(replica_id=0)
+    code, _ = stub.act({"session_id": "s1", "image": [], "task": "corner"})
+    assert code == 200
+    code, _ = stub.act({"session_id": "s1", "image": []})
+    assert code == 200
+    snap = stub.metrics_snapshot()
+    assert snap["task_requests_total"] == {"corner": 1, "unlabeled": 1}
+    assert snap["task_sessions_total"] == {"corner": 1}
+
+
+def test_cycle_scheduler_metric_parity():
+    """Satellite (ISSUE 13): the legacy cycle scheduler emits the same
+    joined_mid_cycle/in-flight families as the continuous one (values 0
+    and 1-in-flight-then-0), so dashboards don't break on
+    `--scheduler cycle`."""
+    import asyncio
+
+    from rt1_tpu.serve.batcher import MicroBatcher
+
+    metrics = ServeMetrics()
+
+    async def drive():
+        batcher = MicroBatcher(
+            lambda items: [i for i in items],
+            max_batch=4,
+            max_delay_s=0.001,
+            metrics=metrics,
+        )
+        await batcher.start()
+        await batcher.submit("a")
+        await batcher.drain()
+
+    asyncio.run(drive())
+    snap = metrics.snapshot()
+    assert snap["joined_mid_cycle_total"] == 0
+    assert snap["batches_in_flight"] == 0
+    assert snap["max_batches_in_flight"] == 1
+    text = prom.render_serve_snapshot(snap)
+    types, _ = parse_exposition(text)
+    assert types["rt1_serve_joined_mid_cycle_total"] == "counter"
+    assert types["rt1_serve_batches_in_flight"] == "gauge"
+
+
+def test_health_task_gauges_exposition():
+    """ISSUE 13 naming contract: the per-task health entries the train
+    loop merges into its scalar stream render as valid
+    rt1_train_health_task_* gauges — including 'unknown:<name>' slugs,
+    whose ':' is legal in exposition metric names."""
+    scalars = {
+        "health/task_loss/block2block": 1.25,
+        "health/task_acc/block2block": 0.5,
+        "health/task_frac/block2block": 0.75,
+        "health/task_loss/unknown:mystery": 2.5,
+        "health/task_frac/other": 0.0,
+    }
+    text = prom.render_scalar_gauges(scalars)
+    types, samples = parse_exposition(text)
+    by_name = {n: float(v) for n, _, v in samples}
+    assert by_name["rt1_train_health_task_loss_block2block"] == 1.25
+    assert by_name["rt1_train_health_task_acc_block2block"] == 0.5
+    assert by_name["rt1_train_health_task_frac_block2block"] == 0.75
+    assert by_name["rt1_train_health_task_loss_unknown:mystery"] == 2.5
+    assert by_name["rt1_train_health_task_frac_other"] == 0.0
+    assert all(t == "gauge" for t in types.values())
+
+
+def test_eval_matrix_gauge_naming():
+    """ISSUE 13 naming contract: the eval-matrix sweep's live gauges
+    render as valid labeled rt1_eval_* families (success rate gauge +
+    episodes counter per {task, checkpoint} cell), with task-slug label
+    escaping shared with the serve-side labels."""
+    from rt1_tpu.eval.matrix import EvalMatrixState
+
+    state = EvalMatrixState()
+    state.note_cell("block2block", "1950", 3, 5, 40.0)
+    state.note_cell("unknown:mystery", "1950", 0, 5, 80.0)
+    state.note_cell("block2block", "3900", 4, 5, 33.0)
+
+    text = state.render_prometheus()
+    types, samples = parse_exposition(text)
+    assert types["rt1_eval_success"] == "gauge"
+    assert types["rt1_eval_episodes_total"] == "counter"
+    assert types["rt1_eval_cells_total"] == "gauge"
+    assert types["rt1_eval_sweep_uptime_seconds"] == "gauge"
+    success = {
+        (labels["task"], labels["checkpoint"]): float(v)
+        for n, labels, v in samples
+        if n == "rt1_eval_success"
+    }
+    assert success[("block2block", "1950")] == pytest.approx(0.6)
+    assert success[("unknown:mystery", "1950")] == 0.0
+    assert success[("block2block", "3900")] == pytest.approx(0.8)
+    episodes = {
+        (labels["task"], labels["checkpoint"]): int(v)
+        for n, labels, v in samples
+        if n == "rt1_eval_episodes_total"
+    }
+    assert episodes[("block2block", "3900")] == 5
+    # A cell started but not yet scored scrapes as 0-rate / 0 episodes —
+    # "running", not fabricated success.
+    state.note_cell_start("play", "3900")
+    _, samples2 = parse_exposition(state.render_prometheus())
+    assert ("rt1_eval_episodes_total", {"task": "play",
+                                        "checkpoint": "3900"}, "0") in samples2
 
 
 def test_family_label_escaping():

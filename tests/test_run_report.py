@@ -298,6 +298,71 @@ def test_serve_quant_bench_renders_dtype_table(tmp_path):
     assert report.index("SLO met.") < report.index("int8 param-byte")
 
 
+def test_eval_matrix_section_renders_table(tmp_path):
+    """ISSUE 13: a BENCH_eval_matrix.json in the workdir renders as a
+    task × checkpoint success table (plus the oracle-fill note); a
+    workdir without one keeps its report matrix-free."""
+    wd = tmp_path / "run"
+    wd.mkdir()
+    record = {
+        "bench": "eval_matrix",
+        "unit": "mean_cell_success_rate",
+        "value": 0.45,
+        "tasks": ["block2block", "block1_to_corner"],
+        "checkpoints": ["1950", "3900"],
+        "episodes_per_cell": 5,
+        "max_episode_steps": 80,
+        "backend": "kinematic",
+        "matrix": {
+            "block2block": {
+                "1950": {"successes": 2, "episodes": 5,
+                         "success_rate": 0.4, "mean_episode_length": 61.0},
+                "3900": {"successes": 4, "episodes": 5,
+                         "success_rate": 0.8, "mean_episode_length": 48.0},
+            },
+            "block1_to_corner": {
+                "1950": {"successes": 0, "episodes": 5,
+                         "success_rate": 0.0, "mean_episode_length": 80.0},
+                # 3900 cell absent: renders as '-', not a crash.
+            },
+        },
+        "oracle_fill": {
+            "episodes_appended": 8,
+            "episodes_per_task": {"block1_to_corner": 8},
+            "shards_after": 2,
+            "freshness_epoch": 1,
+        },
+    }
+    with open(wd / "BENCH_eval_matrix.json", "w") as f:
+        json.dump(record, f)
+
+    loaded = run_report.load_eval_matrix(str(wd))
+    assert loaded is not None
+    report = run_report.render_report(
+        str(wd), None, None, None, eval_matrix=loaded
+    )
+    assert "Eval matrix (task × checkpoint success)" in report
+    assert "2 task(s) × 2 checkpoint(s)" in report
+    assert "mean cell success 0.450" in report
+    assert "ckpt 1950" in report and "ckpt 3900" in report
+    assert "4/5 (0.80)" in report
+    assert "0/5 (0.00)" in report
+    # The missing cell renders as '-'.
+    corner_row = next(
+        line for line in report.splitlines()
+        if line.startswith("block1_to_corner")
+    )
+    assert corner_row.rstrip().endswith("-")
+    assert "Oracle corpus fill: 8 episodes appended" in report
+    # Absent record -> no matrix section at all.
+    plain = run_report.render_report(str(wd), None, None, None)
+    assert "Eval matrix" not in plain
+    # A half-written record degrades to None, not a crash.
+    with open(wd / "BENCH_eval_matrix.json", "w") as f:
+        f.write('{"bench": "eval_ma')
+    assert run_report.load_eval_matrix(str(wd)) is None
+
+
 def test_serve_section_absent_for_training_only_run(tmp_path):
     """A pure training workdir renders NO serve section — the golden
     training report stays byte-stable."""

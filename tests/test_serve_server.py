@@ -138,6 +138,38 @@ def test_act_and_reset_roundtrip(serving_stack):
     _post(url + "/release", {"session_id": "rt"})
 
 
+def test_act_task_labels_in_metrics(serving_stack):
+    """ISSUE 13: the client-declared `task` tag lands in the per-task
+    request/session counters (unlabeled traffic in 'unlabeled'), and the
+    labeled families render on the Prometheus scrape."""
+    _, _, _, url = serving_stack
+    frame = np.zeros((H, W, 3), np.float32).tolist()
+    for i in range(3):
+        status, _ = _post(
+            url + "/act",
+            {
+                "session_id": "task-sess",
+                "image": frame,
+                "instruction": "push the red moon to the blue cube",
+                "task": "block2block",
+            },
+        )
+        assert status == 200
+    status, snap = _get(url + "/metrics")
+    assert status == 200
+    assert snap["task_requests_total"]["block2block"] == 3
+    # One fresh session window under the tag, no matter how many steps.
+    assert snap["task_sessions_total"]["block2block"] == 1
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        text = resp.read().decode("utf-8")
+    assert 'rt1_serve_task_requests_total{task="block2block"} 3' in text
+    assert 'rt1_serve_task_sessions_total{task="block2block"} 1' in text
+    _post(url + "/release", {"session_id": "task-sess"})
+
+
 def test_act_error_paths(serving_stack):
     _, _, _, url = serving_stack
     status, body = _post(url + "/act", {"session_id": "e"})
