@@ -52,9 +52,13 @@ def main():
     # control step (language_table.py:599-646); ours is the kinematic
     # backend + PIL renderer. Needs no accelerator and never claims the
     # chip.
+    # "multihost": 1-process vs 2-process scale-out (scripts/
+    # bench_multihost.py — real jax.distributed groups on forced CPU host
+    # devices) -> the MULTICHIP record; subprocess-based, never claims the
+    # chip either.
     p.add_argument(
         "--mode", default="train",
-        choices=["train", "infer", "e2e", "mfu", "env"]
+        choices=["train", "infer", "e2e", "mfu", "env", "multihost"]
     )
     p.add_argument(
         "--data_dir", default="/tmp/rt1_bench_episodes",
@@ -158,6 +162,28 @@ def main():
             print("bench: --trace_dir is ignored in --mode env (host-only "
                   "loop, no XLA programs to trace)", file=sys.stderr)
         return env_bench(args)
+
+    if args.mode == "multihost":
+        # Subprocess groups on forced CPU host devices — this process
+        # never touches an accelerator, so no chip claim. All knobs live
+        # on the dedicated CLI (scripts/bench_multihost.py); bench.py is
+        # the discoverable front door for the MULTICHIP record.
+        from scripts.bench_multihost import main as multihost_main
+
+        record = multihost_main(["--steps", str(args.steps)])
+        print(
+            json.dumps(
+                {
+                    "metric": "multihost_examples_per_sec_ratio_2p_over_1p",
+                    "value": record["scaling"][
+                        "examples_per_sec_ratio_2p_over_1p"
+                    ],
+                    "unit": "x",
+                    "vs_baseline": 0.0,
+                }
+            )
+        )
+        return
 
     variant = ("_tiny" if args.model == "tiny" else "") + (
         "_packed" if args.packed and args.mode == "e2e" else ""

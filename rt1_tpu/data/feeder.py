@@ -16,6 +16,23 @@ two feeders with the same seed yield identical batch streams, and a
 1-thread feeder reproduces an 8-thread one bit-for-bit (pinned in
 tests/test_feeder.py).
 
+Multi-host (``process_count > 1``, docs/parallelism.md "Multi-host"): the
+epoch order is drawn GLOBALLY — one permutation (or weighted draw), a pure
+function of (seed, epoch, corpus[, weights]) that no process identity
+enters — and each global batch of ``batch_size × process_count`` windows
+is split into per-host blocks: host p assembles rows
+``[p·batch_size, (p+1)·batch_size)`` of global batch b. Host slices are
+therefore disjoint, jointly exhaustive over the batched prefix, and
+CONCATENATE to the exact single-host batch (the layout
+`jax.make_array_from_process_local_data` expects for a batch sharded over
+a host-major mesh, data/pipeline.py `device_feeder`) — all pinned in
+tests/test_feeder.py. Every host draws the same global order, so no
+cross-host coordination happens at epoch boundaries, and — unlike a
+per-host strided slice — every host sees the same per-epoch batch count
+even when the corpus size is not process-divisible (a strided split can
+hand one host an extra batch, which deadlocks the collective at the
+epoch's last step).
+
 Flywheel (`refresh_at_epoch=True`): at every epoch boundary the feeder asks
 the cache to re-read its manifest and open any newly appended shards
 (`PackedEpisodeCache.refresh`), then draws that epoch's shuffle over the
@@ -161,6 +178,25 @@ class SampleAheadFeeder:
         self.depth = max(1, depth)
         self.process_index = process_index
         self.process_count = process_count
+        if refresh_at_epoch and process_count > 1:
+            # The multi-host contract is "every host draws the same global
+            # order by construction" — a pure function of (seed, epoch,
+            # corpus). A flywheel refresh is a per-host filesystem read
+            # with no cross-host barrier: host 0 could see an appended
+            # shard at an epoch boundary that host 1's (slightly earlier,
+            # or failed-and-swallowed) refresh missed, after which the
+            # hosts draw different orders AND different per-epoch batch
+            # counts — overlapping slices and a deadlocked collective at
+            # the shorter host's epoch end. Refuse here, loudly, instead
+            # of corrupting the stream; train/train.py disables the
+            # flywheel hook on multi-process runs for the same reason.
+            raise ValueError(
+                "refresh_at_epoch (the flywheel's mid-run corpus pickup) "
+                "is single-process only: epoch-boundary manifest reads "
+                "have no cross-host synchronization, so hosts could draw "
+                "orders over different corpus snapshots. Restart training "
+                "to absorb appended shards on multi-host runs."
+            )
         self.refresh_at_epoch = refresh_at_epoch
         # Task-mixture sampling (docs/data.md "Task-mixture sampling"):
         # with weights, each epoch's order is a weighted draw WITH
@@ -212,7 +248,8 @@ class SampleAheadFeeder:
         self.batches_per_epoch = self._epochs[0]["batches"]
         if self.batches_per_epoch == 0:
             raise ValueError(
-                f"batch_size {batch_size} exceeds this process's "
+                f"global batch ({batch_size} per host x "
+                f"{self.process_count} processes) exceeds the corpus's "
                 f"{len(self._epochs[0]['order'])} windows"
             )
         # Static corpora keep the exact pre-flywheel exhaustion arithmetic;
@@ -252,10 +289,12 @@ class SampleAheadFeeder:
     # ------------------------------------------------------------ schedule
 
     def _compute_order(self, epoch: int, n_windows: int) -> np.ndarray:
-        """This process's window order for `epoch` over an `n_windows`
-        corpus — a pure function of (seed, epoch, n_windows[, weights]),
-        so every feeder that sees the same corpus at epoch e draws the
-        same order no matter when the corpus reached that size.
+        """The GLOBAL window order for `epoch` over an `n_windows` corpus —
+        a pure function of (seed, epoch, n_windows[, weights]) that the
+        process identity never enters: every host of a multi-process run
+        draws this same order and takes its block of each global batch
+        (`_host_indices`), so the global stream is exactly the
+        single-host stream no matter how many hosts split it.
 
         task_weights=None keeps the EXACT pre-task permutation draw (same
         rng key, same shuffle — bit-identical, pinned in tests). With
@@ -276,14 +315,44 @@ class SampleAheadFeeder:
             rng = np.random.default_rng(
                 [self.seed, epoch, self._weights_key]
             )
-            order = rng.choice(
+            return rng.choice(
                 n_windows, size=n_windows, replace=True, p=w / total
             )
-            return order[self.process_index :: self.process_count]
         order = np.arange(n_windows)
         if self.shuffle:
             np.random.default_rng([self.seed, epoch]).shuffle(order)
-        return order[self.process_index :: self.process_count]
+        return order
+
+    @property
+    def global_batch_size(self) -> int:
+        """Windows per GLOBAL batch (all hosts' shards together)."""
+        return self.batch_size * self.process_count
+
+    def _host_indices(self, order: np.ndarray, b: int) -> np.ndarray:
+        """This host's `batch_size` window indices of global batch `b`:
+        rows [p·B, (p+1)·B) of the order's b-th global-batch block. Hosts'
+        slices concatenate (in process order) to the exact single-host
+        batch — the contract `jax.make_array_from_process_local_data`
+        needs for a batch dim sharded over a host-major mesh."""
+        base = b * self.global_batch_size + self.process_index * self.batch_size
+        return order[base : base + self.batch_size]
+
+    def host_order(self, epoch: int) -> np.ndarray:
+        """This host's window sequence for `epoch` (batched prefix only:
+        the order's tail that fills no complete global batch is dropped on
+        every host alike). Observability/test accessor — assembly reads
+        `_host_indices` per batch."""
+        order = self._order_for(epoch)
+        nb = len(order) // self.global_batch_size
+        if self.process_count == 1:
+            return order[: nb * self.global_batch_size]
+        return (
+            order[: nb * self.global_batch_size]
+            .reshape(nb, self.process_count, self.batch_size)[
+                :, self.process_index
+            ]
+            .reshape(-1)
+        )
 
     def _window_weights(self, n_windows: int) -> np.ndarray:
         """(n_windows,) float64 sampling weight per window: the window's
@@ -327,7 +396,11 @@ class SampleAheadFeeder:
         self._epochs.append(
             {
                 "first": first,
-                "batches": len(order) // self.batch_size,
+                # Batch counts are GLOBAL-batch counts: identical on every
+                # host by construction, so multi-process epochs end in
+                # lockstep (a per-host count could differ when the corpus
+                # is not process-divisible — a collective deadlock).
+                "batches": len(order) // self.global_batch_size,
                 "order": order,
                 "windows": n_windows,
             }
@@ -364,7 +437,7 @@ class SampleAheadFeeder:
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         """This process's window order for `epoch` (thread-count-free)."""
-        return self._order_for(epoch)
+        return self.host_order(epoch)
 
     def _past_end(self, ticket: int) -> bool:
         if self.num_epochs is None:
@@ -395,7 +468,7 @@ class SampleAheadFeeder:
     def _assemble(self, ticket: int) -> Dict:
         epoch, b = self._locate(ticket)
         order = self._order_for(epoch)
-        indices = order[b * self.batch_size : (b + 1) * self.batch_size]
+        indices = self._host_indices(order, b)
         rng = self._batch_rng(epoch, b)
         n, w = len(indices), self.cache.window
         h, wd = self.cache.height, self.cache.width
@@ -403,7 +476,21 @@ class SampleAheadFeeder:
         embeds = np.empty((n, w, self._embed_dim), np.float32)
         terms = np.empty((n, w), np.int32)
         actions = np.empty((n, w, self._action_dim), np.float32)
-        self.cache.fill_batch(indices, rng, images, embeds, terms, actions)
+        offsets = None
+        if self.process_count > 1:
+            # Multi-host crop parity: the crop rng is keyed on the GLOBAL
+            # (epoch, batch) coordinates, so every host must consume it
+            # identically — draw the full global batch's offsets and keep
+            # this host's rows. One extra (global_batch·window, 2) integer
+            # draw per batch; the frame gather stays per-host-sized.
+            all_offsets = self.cache.draw_packed_offsets(
+                rng, self.global_batch_size * w
+            )
+            lo = self.process_index * self.batch_size * w
+            offsets = all_offsets[lo : lo + n * w]
+        self.cache.fill_batch(
+            indices, rng, images, embeds, terms, actions, offsets=offsets
+        )
         observations = {
             "image": images,
             "natural_language_embedding": embeds,

@@ -909,6 +909,7 @@ class PackedEpisodeCache:
         terms: np.ndarray,
         actions: np.ndarray,
         threads: int = 1,
+        offsets: Optional[np.ndarray] = None,
     ) -> None:
         """Assemble a whole batch into preallocated buffers, vectorized.
 
@@ -919,11 +920,19 @@ class PackedEpisodeCache:
         distribution matches the per-window path (`draw_packed_offsets`);
         byte-level stream parity with `get_window` is not a goal here —
         determinism is the feeder's (seed, epoch, batch) contract.
+
+        ``offsets`` ((n·window, 2) int32 packed crop offsets) substitutes
+        for the rng draw — the multi-host path: each host of a
+        process-sharded feeder draws the GLOBAL batch's offsets from the
+        shared (seed, epoch, batch) rng and passes only its rows here, so
+        per-host shards concatenate to the exact single-host batch,
+        augmentation included (rt1_tpu/data/feeder.py `_assemble`).
         """
         n = len(indices)
         w = self.window
         h, wd = self.height, self.width
-        offsets = self.draw_packed_offsets(rng, n * w)
+        if offsets is None:
+            offsets = self.draw_packed_offsets(rng, n * w)
         # Global frame indices: episode frame offset + padded source step.
         gidx = np.empty((n, w), np.int64)
         for i, idx in enumerate(indices):

@@ -348,6 +348,34 @@ def _stack_tree(samples: List[Dict]) -> Dict:
     return out
 
 
+def put_global(batch, sharding):
+    """Lay one host batch out per `sharding` — multi-process aware.
+
+    Single process: one async `jax.device_put` (the fast path, unchanged).
+    Multi-process: each host holds only ITS rows of the global batch (the
+    feeder's per-host block slice), so the global array is assembled with
+    `jax.make_array_from_process_local_data` — every leaf's global leading
+    dim is local_rows × process_count, matching a batch dim sharded over
+    the host-major (data, fsdp) mesh axes where each host's devices own
+    exactly its contiguous row block. No cross-host data moves: the
+    "assembly" is metadata + local H2D.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    nproc = jax.process_count()
+
+    def put(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * nproc,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape
+        )
+
+    return jax.tree.map(put, batch)
+
+
 def prefetch_to_device(iterator, sharding, depth: int = 2) -> Iterator:
     """Double-buffered H2D: keep `depth` batches resident on device.
 
@@ -356,13 +384,13 @@ def prefetch_to_device(iterator, sharding, depth: int = 2) -> Iterator:
     device compute of step N (VERDICT r1 weak #3 — the single-buffered loop
     serialized H2D into the step). Equivalent of
     `flax.jax_utils.prefetch_to_device`, but laying batches out with an
-    explicit (mesh) sharding instead of pmap's leading device axis.
+    explicit (mesh) sharding instead of pmap's leading device axis. On
+    multi-process runs each host feeds its shard of the global batch
+    (`put_global`).
     """
-    import jax
-
     queue = collections.deque()
     for batch in iterator:
-        queue.append(jax.device_put(batch, sharding))
+        queue.append(put_global(batch, sharding))
         if len(queue) >= max(depth, 1):
             yield queue.popleft()
     while queue:
@@ -386,9 +414,11 @@ def to_obs_actions(batch):
 
 def device_feeder(iterator, batch_sharding, depth: int = 1) -> Iterator:
     """Lay host batches out on the mesh as (observations, actions) tuples of
-    sharded jax.Arrays — the multi-host story is `jax.make_array_from_
-    process_local_data` semantics: each host feeds its shard of the batch.
-    `depth=2` double-buffers (see `prefetch_to_device`)."""
+    sharded jax.Arrays. On a multi-process run each host's iterator yields
+    its block of the global batch and `put_global` assembles the global
+    `jax.Array` via `jax.make_array_from_process_local_data`; single-process
+    keeps the plain async `device_put`. `depth=2` double-buffers (see
+    `prefetch_to_device`)."""
     return prefetch_to_device(
         map(to_obs_actions, iterator), batch_sharding, depth=depth
     )

@@ -68,16 +68,35 @@ def restore_variables(config, workdir, step=None):
     Returns (model, variables, restored_step, family, lava_clip). Raises
     FileNotFoundError on an empty workdir — silently serving/evaluating
     randomly initialized weights would be worse than failing.
+
+    The restore is a PLAN MIGRATION (parallel/reshard.py): the template
+    carries this process's serving plan, so a checkpoint trained on a pod
+    under fsdp/tp lands directly in the serve host's layout — for the
+    default all-ones plan that is one device, i.e. a 1-device replica
+    always loads a big-mesh checkpoint. A train config whose model axes
+    exceed this host's devices falls back to plain single-host placement
+    (the layout Orbax derives from the concrete template) with a warning,
+    instead of refusing to serve.
     """
     from rt1_tpu.trainer.checkpoints import CheckpointConfig, CheckpointManager
 
     model, state, family, lava_clip = build_model_and_state(config)
+    try:
+        plan = serving_plan(config)
+    except ValueError as exc:
+        from absl import logging
+
+        logging.warning(
+            "eval/restore: serving plan unsatisfiable on this host (%s) — "
+            "restoring with plain placement", exc,
+        )
+        plan = None
     ckpt = CheckpointManager(
         CheckpointConfig(
             directory=os.path.join(os.path.abspath(workdir), "checkpoints")
         )
     )
-    state = ckpt.restore(state, step=step)
+    state = ckpt.restore(state, step=step, plan=plan)
     restored_step = step if step is not None else ckpt.latest_step()
     return model, _variables_from_state(state), restored_step, family, lava_clip
 

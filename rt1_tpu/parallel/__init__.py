@@ -13,6 +13,11 @@ mesh, resolved once from `config.parallel` and consumed identically by train,
 eval, and serve — dense/fsdp/tp/pp are config switches, not code paths.
 """
 
+from rt1_tpu.parallel.distributed import (
+    DistributedOptions,
+    initialize_from_config,
+    is_primary,
+)
 from rt1_tpu.parallel.mesh import MeshConfig, make_mesh
 from rt1_tpu.parallel.pipeline import (
     pipeline_apply,
@@ -39,10 +44,13 @@ from rt1_tpu.parallel.sharding import (
 
 __all__ = [
     "AUTO_MESH_SHAPES",
+    "DistributedOptions",
     "MeshConfig",
     "PlanCoverageError",
     "ShardingPlan",
     "auto_mesh_shape",
+    "initialize_from_config",
+    "is_primary",
     "make_mesh",
     "batch_sharding",
     "mixed_precision_from_config",

@@ -48,18 +48,45 @@ Rule = Tuple[str, P]
 # ladder (small slices mix dp×fsdp, 8 adds tp, 16 goes fsdp×tp-heavy); the
 # fallback for unlisted counts is pure fsdp — the memory-optimal default for
 # a model that fits compute-bound on every chip.
+#
+# Keys are GLOBAL device counts (`jax.devices()`, host-major on multi-host
+# slices — never `jax.local_devices()`): the 32/64 rows are pod-slice
+# topologies where `dp` is the axis that crosses hosts. Because the mesh
+# reshape is host-major with `dp` outermost (mesh.py), keeping fsdp×tp at or
+# below the per-host device count keeps the bandwidth-hungry weight
+# all-gathers on intra-host ICI while the (once-per-step, overlappable)
+# gradient psum takes the DCN hops — `auto_mesh_shape` rebalances fsdp→dp
+# when a row's model axes would spill across hosts.
 AUTO_MESH_SHAPES = {
     1: (1, 1, 1),
     2: (2, 1, 1),
     4: (2, 2, 1),
     8: (2, 2, 2),
     16: (1, 4, 4),
+    32: (4, 4, 2),
+    64: (8, 4, 2),
 }
 
 
-def auto_mesh_shape(n_devices: int) -> Tuple[int, int, int]:
-    """(dp, fsdp, tp) for `n_devices`, per AUTO_MESH_SHAPES."""
-    return AUTO_MESH_SHAPES.get(n_devices, (1, n_devices, 1))
+def auto_mesh_shape(
+    n_devices: int, local_device_count: Optional[int] = None
+) -> Tuple[int, int, int]:
+    """(dp, fsdp, tp) for `n_devices` GLOBAL devices, per AUTO_MESH_SHAPES.
+
+    ``local_device_count`` (multi-host runs: `jax.local_device_count()`)
+    keeps the table's rows host-contiguous: when a row's fsdp×tp product
+    exceeds one host's devices, factors of 2 move from ``fsdp`` to ``dp``
+    until the model axes fit inside a host — fsdp all-gathers stay on
+    intra-host ICI and only the data-parallel gradient reduction crosses
+    DCN. A single-host call (``local_device_count`` None or >= n_devices)
+    returns the table row unchanged.
+    """
+    dp, fsdp, tp = AUTO_MESH_SHAPES.get(n_devices, (1, n_devices, 1))
+    if local_device_count is not None and 0 < local_device_count < n_devices:
+        while fsdp > 1 and fsdp % 2 == 0 and fsdp * tp > local_device_count:
+            fsdp //= 2
+            dp *= 2
+    return dp, fsdp, tp
 
 
 def rt1_sharding_plan() -> List[Rule]:
@@ -413,16 +440,28 @@ class ShardingPlan:
         par = _get(config, "parallel")
         if par is not None:
             if _get(par, "auto", False):
-                n = n_devices if n_devices is not None else len(
-                    devices if devices is not None else jax.devices()
-                )
+                # Resolution is against the GLOBAL device set (`jax.
+                # devices()`, host-major on a multi-process slice) — the
+                # mesh spans every process's devices; `jax.local_devices()`
+                # would build N disjoint single-host meshes instead of one
+                # slice-wide program.
+                local = None
+                if n_devices is None and devices is None:
+                    pool = jax.devices()
+                    n = len(pool)
+                    if jax.process_count() > 1:
+                        local = jax.local_device_count()
+                else:
+                    n = n_devices if n_devices is not None else len(devices)
                 pp = int(_get(par, "pp", 1))
                 sp = int(_get(par, "sp", 1))
                 # pp/sp are honored as configured: the auto table splits
                 # only the devices left after the stage/seq axes take
                 # theirs, so auto composes with pp>1 or sp>1 instead of
                 # over-subscribing the mesh.
-                dp, fsdp, tp = auto_mesh_shape(max(n // max(pp * sp, 1), 1))
+                dp, fsdp, tp = auto_mesh_shape(
+                    max(n // max(pp * sp, 1), 1), local
+                )
             else:
                 dp = int(_get(par, "dp", -1))
                 fsdp = int(_get(par, "fsdp", 1))

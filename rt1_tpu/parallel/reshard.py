@@ -1,0 +1,127 @@
+"""Checkpoint plan migration: restore a checkpoint saved under plan A onto
+mesh/plan B.
+
+Before this module a checkpoint's layout was a lucky coincidence: Orbax
+restores into whatever template it is handed, and every caller handed it
+the *current process's* concrete arrays — so train-on-a-big-mesh →
+serve-on-small-replicas only worked when the layouts happened to line up.
+Here migration is first-class and both ends resolve from the SAME rule
+list (`parallel/plan.py`): the source plan decided where shards were
+written; the target plan decides where they land. Because Orbax stores
+GLOBAL logical arrays (per-host shard files + layout metadata), a restore
+that presents target shardings is the entire migration — dense→fsdp,
+4→8 devices, train-mesh→1-device serve replica — with no gather program
+of our own on the happy path.
+
+Two paths, consumed by `trainer/checkpoints.py` (``restore(plan=...)`` /
+``restore_or_initialize(plan=...)``) and `eval/restore.py`:
+
+* **Sharded restore** (`abstract_target`): the restore template is a
+  pytree of `jax.ShapeDtypeStruct`s carrying the TARGET plan's
+  `NamedSharding` per leaf — Orbax lays each global array out directly on
+  the target mesh, reading only the bytes each host needs.
+* **Host fallback** (`place_on_plan`): restore into plain host arrays,
+  then gather→slice — `np.asarray` materializes each full leaf on host
+  and one `jax.device_put` against the target shardings slices it onto
+  the mesh. Single-process only (a host cannot materialize another
+  host's shards); it exists for serve replicas on small hosts and for
+  Orbax versions that reject abstract templates.
+
+Round-trip contract (tests/test_reshard.py): save under the dense plan on
+a 4-device mesh, restore under fsdp on an 8-device mesh (and back) with
+bit-identical gathered params; `eval/restore.py` loads the same
+checkpoint into a 1-device serve engine.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from rt1_tpu.parallel.plan import ShardingPlan
+
+
+def target_shardings(tree: Any, plan: ShardingPlan) -> Any:
+    """Per-leaf TARGET `NamedSharding`s for `tree` under `plan` — the same
+    rule resolution the train step and serve placement use
+    (`ShardingPlan.tree_shardings`), so a checkpoint migrates onto exactly
+    the layout the consumer will compute with. Coverage is NOT re-checked
+    here: the plan's consumer already ran `check_coverage` at build time,
+    and a restore must not warn twice for the same decision."""
+    return plan.tree_shardings(tree, check=False)
+
+
+def abstract_target(tree: Any, plan: ShardingPlan) -> Any:
+    """Restore template for a sharded (resharding) Orbax restore: each
+    array leaf of `tree` becomes a `jax.ShapeDtypeStruct` with the target
+    plan's sharding attached; non-array leaves pass through untouched.
+
+    Shapes/dtypes come from `tree` (the freshly initialized state — the
+    structural contract), placement from `plan` — which is how the SAME
+    template restores a dense-saved checkpoint onto an fsdp mesh: the
+    saved layout is metadata Orbax already has, only the target layout is
+    ours to declare."""
+    shardings = target_shardings(tree, plan)
+
+    def one(leaf, sh):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+        return leaf
+
+    return jax.tree.map(one, tree, shardings)
+
+
+def gather_to_host(tree: Any) -> Any:
+    """Full host (numpy) copies of every array leaf — the "gather" half of
+    the fallback path. Raises on non-addressable leaves: in a multi-process
+    run a host only holds its own shards, and silently padding the rest
+    with garbage would be far worse than failing."""
+
+    def one(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            raise ValueError(
+                "reshard.gather_to_host: leaf is not fully addressable from "
+                "this process — the host-fallback path is single-process "
+                "only; use the sharded restore (abstract_target) on "
+                "multi-host meshes"
+            )
+        return np.asarray(leaf) if hasattr(leaf, "shape") else leaf
+
+    return jax.tree.map(one, tree)
+
+
+def place_on_plan(tree: Any, plan: ShardingPlan) -> Any:
+    """The "slice" half of the fallback: lay host (or differently-laid-out
+    device) arrays onto the target plan in one `device_put` — each device
+    receives only its rule-decided shard. Non-array leaves pass through."""
+    host = gather_to_host(tree)
+    shardings = target_shardings(host, plan)
+
+    def one(leaf, sh):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.device_put(leaf, sh)
+        return leaf
+
+    return jax.tree.map(one, host, shardings)
+
+
+def gathered_equal(a: Any, b: Any) -> bool:
+    """Bit-identity of two (possibly differently sharded) pytrees after
+    gathering to host — the round-trip assertion: a checkpoint migrated
+    A→B→A must hand back the exact bytes it started from."""
+    ha, hb = gather_to_host(a), gather_to_host(b)
+    leaves_a, treedef_a = jax.tree.flatten(ha)
+    leaves_b, treedef_b = jax.tree.flatten(hb)
+    if treedef_a != treedef_b:
+        return False
+    for la, lb in zip(leaves_a, leaves_b):
+        xa, xb = np.asarray(la), np.asarray(lb)
+        if xa.dtype != xb.dtype or xa.shape != xb.shape:
+            return False
+        # Byte comparison, not value comparison: NaNs must round-trip too,
+        # and -0.0 vs 0.0 is a migration bug worth catching.
+        if xa.tobytes() != xb.tobytes():
+            return False
+    return True

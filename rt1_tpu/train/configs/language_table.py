@@ -183,6 +183,22 @@ def get_config():
     # compute dtype to bfloat16; f32 softmax/CE unchanged). Off = the
     # bit-identical pre-change f32 program.
     config.parallel.mixed_precision = False
+    # Multi-process (multi-host) scale-out (rt1_tpu/parallel/distributed
+    # .py, docs/parallelism.md "Multi-host"): with `enabled`, the train
+    # entry runs `jax.distributed.initialize` BEFORE any device access, so
+    # the plan resolves against the slice's global devices, per-host
+    # feeders slice the global stream, and Orbax coordinates multihost
+    # checkpoints. One config serves every host: leave process_id /
+    # num_processes at -1 and set RT1_COORDINATOR / RT1_PROCESS_ID /
+    # RT1_NUM_PROCESSES per host (or nothing at all on TPU pods — the
+    # runtime reads the metadata server).
+    config.parallel.distributed = ml_collections.ConfigDict()
+    config.parallel.distributed.enabled = False
+    config.parallel.distributed.coordinator_address = (
+        ml_collections.config_dict.placeholder(str)
+    )
+    config.parallel.distributed.process_id = -1
+    config.parallel.distributed.num_processes = -1
 
     # Observability (rt1_tpu/obs/, docs/observability.md). Defaults are
     # resolved by obs.ObsOptions.from_config, so configs without this block

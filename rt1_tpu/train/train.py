@@ -344,9 +344,14 @@ def _packed_batches(
         stall_timeout_s=config.data.get("feeder_stall_timeout_s"),
         # Data flywheel: re-read the pack manifest at epoch boundaries and
         # pick up appended shards mid-run (train split only — eval streams
-        # should stay pinned to one corpus).
+        # should stay pinned to one corpus). Single-process only: a
+        # per-host refresh has no cross-host barrier, so multi-host runs
+        # keep the corpus pinned for the whole run (the feeder raises on
+        # the combination; restart to absorb appended shards).
         refresh_at_epoch=(
-            split == "train" and config.data.get("packed_refresh", False)
+            split == "train"
+            and config.data.get("packed_refresh", False)
+            and jax.process_count() == 1
         ),
         task_weights=task_weights,
         emit_task_ids=emit_task_ids,
@@ -452,6 +457,21 @@ def dataset_batches(config, split="train", seed=None) -> Iterator:
     )
 
 
+def _state_for_save(state):
+    """The tree handed to Orbax at save time.
+
+    Single process keeps the historical `jax.device_get` (host numpy —
+    saves never hold device buffers while serializing). Multi-process
+    hands over the sharded `jax.Array`s untouched: device_get of a
+    dp/fsdp-sharded leaf would raise (this host cannot address the other
+    hosts' shards), and Orbax's multihost path wants the global arrays —
+    each host then writes exactly its own shard bytes.
+    """
+    if jax.process_count() == 1:
+        return jax.device_get(state)
+    return state
+
+
 def train_and_evaluate(config, workdir: str):
     """Run the training loop; returns the final TrainState.
 
@@ -466,6 +486,14 @@ def train_and_evaluate(config, workdir: str):
     for configs without a `resilience` block.
     """
     from rt1_tpu import obs, resilience
+
+    # Multi-process rendezvous FIRST — before any device access (the plan
+    # resolves against the global device set, and a post-backend-init
+    # rendezvous is too late). No-op unless `config.parallel.distributed`
+    # is enabled; idempotent across runs in one process.
+    from rt1_tpu.parallel import initialize_from_config
+
+    initialize_from_config(config)
 
     # Observability first: the tracer must be live before dataset_batches
     # spawns feeder workers, or their assembly spans are lost.
@@ -532,22 +560,42 @@ def train_and_evaluate(config, workdir: str):
     )
     model, init_fn, loss_fn = build_family(config.model, mesh=mesh)
     data_size = sharding_plan.data_parallel_size
-    if config.per_host_batch_size % data_size != 0:
+    # The batch the jitted step sees is GLOBAL: per-host rows × processes
+    # (each host feeds its block, data/pipeline.py `put_global`). The
+    # mesh's batch ways must divide it, and on a host-major mesh each
+    # host's rows must map onto its own devices — per-host divisibility by
+    # the per-host share of the batch axes.
+    nproc = jax.process_count()
+    global_batch = config.per_host_batch_size * nproc
+    if nproc > 1 and data_size % nproc != 0:
+        # Each host feeds only its own rows, so a batch shard must never
+        # span hosts (and a batch-REPLICATED mesh, data_size < nproc,
+        # cannot be fed per-host rows at all). Reject at the config seam
+        # rather than deep inside the first prefetch's
+        # make_array_from_process_local_data.
+        raise ValueError(
+            f"multi-process run: the mesh batch axes (data x fsdp = "
+            f"{data_size} ways) must divide evenly across "
+            f"{nproc} processes — give dp (or fsdp) a multiple of the "
+            f"process count"
+        )
+    per_host_ways = data_size // nproc if nproc > 1 else data_size
+    if config.per_host_batch_size % per_host_ways != 0:
         raise ValueError(
             f"per_host_batch_size={config.per_host_batch_size} must be "
-            f"divisible by the mesh batch axes (data x fsdp = "
-            f"{data_size} ways)"
+            f"divisible by this host's share of the mesh batch axes "
+            f"({per_host_ways} of data x fsdp = {data_size} ways)"
         )
     if mesh.shape["stage"] > 1:
         accum = max(int(config.get("accum_steps", 1)), 1)
         # Each accumulation microstep forwards batch/accum rows, sharded
         # over data — that is the batch pipeline_apply actually sees.
-        shard_batch = config.per_host_batch_size // data_size // accum
+        shard_batch = global_batch // data_size // accum
         micro = config.model.get("pipeline_microbatches", 4)
         if shard_batch == 0 or shard_batch % micro != 0:
             raise ValueError(
                 f"pipeline parallelism: per-data-shard per-accum-step batch "
-                f"{shard_batch} (= {config.per_host_batch_size} / "
+                f"{shard_batch} (= global batch {global_batch} / "
                 f"{data_size} data shards / {accum} accum steps) must be a "
                 f"positive multiple of pipeline_microbatches={micro}"
             )
@@ -640,7 +688,11 @@ def train_and_evaluate(config, workdir: str):
             on_io=ledger.note_io if ledger is not None else None,
         )
     )
-    state, initial_step = ckpt.restore_or_initialize(state)
+    # Plan-migrating restore (parallel/reshard.py): the template carries
+    # the CURRENT plan's target shardings, so a checkpoint saved under a
+    # different mesh/plan (a bigger slice, dense vs fsdp) resumes directly
+    # in this run's layout instead of relying on a layout coincidence.
+    state, initial_step = ckpt.restore_or_initialize(state, plan=sharding_plan)
 
     fns = make_train_step_fns(
         model, mesh, state, accum_steps=config.accum_steps, loss_fn=loss_fn,
@@ -1004,7 +1056,7 @@ def train_and_evaluate(config, workdir: str):
                     "restoring checkpoint step %d with a fresh data seed",
                     step + 1, step_guard.last_reason, target,
                 )
-                state = ckpt.restore(state, step=target)
+                state = ckpt.restore(state, step=target, plan=sharding_plan)
                 step_guard.notify_rollback(target)
                 # Fresh stream offset: re-walking the exact batch sequence
                 # would reproduce the divergence deterministically.
@@ -1055,10 +1107,13 @@ def train_and_evaluate(config, workdir: str):
                 # prefetch overlap. Trace-span only, NOT a timeline bucket:
                 # this runs between steps, and folding multi-second saves
                 # into the next step's host bucket would make its buckets
-                # exceed its total.
+                # exceed its total. Multi-process: NO device_get — a host
+                # cannot materialize other hosts' fsdp/dp shards; Orbax
+                # takes the sharded jax.Arrays and each host writes its own
+                # shard files.
                 with obs.trace.span("checkpoint_save", step=step + 1):
                     saved = ckpt.save(
-                        step + 1, jax.device_get(state), force=last
+                        step + 1, _state_for_save(state), force=last
                     )
 
             if coordinator is not None and coordinator.triggered:
@@ -1082,7 +1137,7 @@ def train_and_evaluate(config, workdir: str):
                     if not saved:
                         with obs.trace.span("preempt_save", step=step + 1):
                             ckpt.save(
-                                step + 1, jax.device_get(state), force=True
+                                step + 1, _state_for_save(state), force=True
                             )
                     _close_host_iter()
                 break

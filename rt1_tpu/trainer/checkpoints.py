@@ -10,6 +10,21 @@ Orbax is multihost-aware out of the box (each host writes its shards of a
 sharded TrainState; restore lays arrays back out on the mesh), which is the
 TPU-native replacement for clu's multihost rendezvous.
 
+Plan migration (rt1_tpu/parallel/reshard.py, docs/parallelism.md
+"Multi-host"): ``restore(plan=...)`` / ``restore_or_initialize(plan=...)``
+restore a checkpoint saved under one sharding plan onto a different
+mesh/plan — the template becomes abstract arrays carrying the TARGET
+plan's shardings, so Orbax lays every global array out on the new mesh
+(dense→fsdp, 4→8 devices, train-mesh→serve-replica) with a single-process
+gather→slice fallback for Orbax versions that reject abstract templates.
+
+Multi-process discipline: every process participates in save/restore
+(Orbax coordinates the shard writes and the commit internally), but the
+side-band artifacts OUR layer adds — the ``saved_under.json`` provenance
+marker — are written by process 0 only, and the module-level
+`latest_step` scan tolerates another host's in-progress Orbax tmp dirs
+(proven under two real processes in tests/test_multiprocess.py).
+
 Resilience (rt1_tpu/resilience/, docs/resilience.md): `CheckpointConfig.
 retry` wraps save/restore in exponential-backoff retry so a transient
 filesystem error degrades to a logged warning instead of killing the run;
@@ -99,10 +114,63 @@ class CheckpointManager:
                 step, args=ocp.args.StandardSave(state), force=force
             )
 
-        return bool(self._io(_save, "ckpt_save"))
+        saved = bool(self._io(_save, "ckpt_save"))
+        if saved:
+            self._write_provenance(step)
+        return saved
 
-    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
-        """Restore into the structure/shardings of `state_like`."""
+    def _write_provenance(self, step: int) -> None:
+        """`saved_under.json`: the topology this checkpoint was written
+        from (process/device counts + newest step) — what `reshard` names
+        in its diagnostics when a migrated restore fails, and the
+        restore-on-a-different-slice post-mortem's first question. Process
+        0 ONLY (the one multi-process rule for side-band files: N hosts
+        racing one marker is how markers get torn), atomic tmp+rename,
+        best-effort — provenance must never take down checkpointing."""
+        import json
+        import os
+
+        import jax
+
+        from rt1_tpu.parallel.distributed import is_primary
+
+        if not is_primary():
+            return
+        try:
+            path = os.path.join(self._config.directory, "saved_under.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "step": int(step),
+                        "process_count": int(jax.process_count()),
+                        "device_count": int(jax.device_count()),
+                        "local_device_count": int(jax.local_device_count()),
+                        "written_at_unix": time.time(),
+                    },
+                    f,
+                    indent=2,
+                    sort_keys=True,
+                )
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 - marker only
+            pass
+
+    def restore(
+        self, state_like: Any, step: Optional[int] = None, plan: Any = None
+    ) -> Any:
+        """Restore into the structure/shardings of `state_like`.
+
+        With ``plan`` (a `parallel.ShardingPlan`) the restore is a PLAN
+        MIGRATION (parallel/reshard.py): `state_like` contributes only the
+        tree structure and shapes/dtypes; placement comes from the target
+        plan's rules, so a checkpoint saved under a different mesh/plan
+        (dense→fsdp, 4→8 devices, pod→serve-replica) lands directly in the
+        layout this process computes with. If this Orbax version rejects
+        the abstract sharded template, a single-process gather→slice
+        fallback restores into `state_like` and re-places through the plan
+        (loudly — on a multi-host mesh the fallback raises instead).
+        """
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
@@ -117,13 +185,41 @@ class CheckpointManager:
             faults.maybe_fail(
                 "ckpt_restore", index=op, what=f"restore step {step}"
             )
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(state_like)
-            )
+            if plan is None:
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(state_like)
+                )
+            from rt1_tpu.parallel import reshard
+
+            template = reshard.abstract_target(state_like, plan)
+            try:
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+            except (TypeError, ValueError, NotImplementedError) as exc:
+                # Only template-shape rejections (an Orbax that cannot
+                # take abstract sharded templates) — I/O and corruption
+                # errors must propagate to restore_or_initialize's
+                # older-step fallback WITHOUT a pointless second full
+                # restore of the same broken step.
+                import jax
+                from absl import logging
+
+                if jax.process_count() > 1:
+                    raise  # a host cannot materialize other hosts' shards
+                logging.warning(
+                    "checkpoint: sharded (plan-target) restore of step %d "
+                    "rejected (%s: %s) — falling back to host gather→slice",
+                    step, type(exc).__name__, exc,
+                )
+                restored = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(state_like)
+                )
+                return reshard.place_on_plan(restored, plan)
 
         return self._io(_restore, "ckpt_restore")
 
-    def restore_or_initialize(self, state_like: Any):
+    def restore_or_initialize(self, state_like: Any, plan: Any = None):
         """(state, step): restored latest, or the passed-in init at step 0.
 
         Mirrors `clu.checkpoint.restore_or_initialize` semantics
@@ -134,7 +230,10 @@ class CheckpointManager:
         kill, truncated by a full disk): a failed restore logs loudly and
         falls back to the next-older retained step instead of wedging the
         relaunch; only when EVERY retained step fails does the original
-        error propagate.
+        error propagate. ``plan`` passes through to :meth:`restore` — the
+        resume path is plan-migrating too, so a run relaunched on a
+        different slice shape restores the old slice's checkpoint directly
+        into the new layout.
         """
         steps = sorted(self.all_steps(), reverse=True)
         if not steps:
@@ -142,7 +241,7 @@ class CheckpointManager:
         last_exc: Optional[Exception] = None
         for step in steps:
             try:
-                return self.restore(state_like, step), int(step)
+                return self.restore(state_like, step, plan=plan), int(step)
             except Exception as exc:  # noqa: BLE001 - fall back per step
                 from absl import logging
 

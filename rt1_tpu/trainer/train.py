@@ -60,16 +60,40 @@ class TrainStepFns:
     health_names: Tuple[str, ...] = ()
 
     def shard_state(self, state: TrainState) -> TrainState:
+        """Place the state per the plan. Multi-process meshes cannot
+        `device_put` host values onto non-addressable devices; there the
+        state round-trips through host numpy into a jitted identity with
+        the plan's out_shardings — every process passes the same
+        deterministic init (or the same restored globals), and XLA lays
+        each leaf out on the global mesh."""
+        if jax.process_count() > 1:
+            def host_or_global(x):
+                # Leaves already laid out on the global mesh (a
+                # plan-migrating restore) pass straight through; local
+                # leaves (fresh deterministic init) go via host numpy.
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    return x
+                return jax.device_get(x)
+
+            state = jax.tree.map(host_or_global, state)
+            return jax.jit(lambda s: s, out_shardings=self.state_sharding)(
+                state
+            )
         return jax.device_put(state, self.state_sharding)
 
     def shard_batch(self, batch: Batch) -> Batch:
-        return jax.device_put(batch, self.batch_sharding)
+        from rt1_tpu.data.pipeline import put_global
+
+        return put_global(batch, self.batch_sharding)
 
     def init_guard_skips(self) -> jax.Array:
         """Replicated int32 zero: the cumulative skip counter's seed value."""
-        return jax.device_put(
-            jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
-        )
+        repl = NamedSharding(self.mesh, P())
+        if jax.process_count() > 1:
+            return jax.jit(
+                lambda: jnp.zeros((), jnp.int32), out_shardings=repl
+            )()
+        return jax.device_put(jnp.zeros((), jnp.int32), repl)
 
 
 def _loss_fn(model, params, batch_stats, batch: Batch, rng: jax.Array, train: bool):

@@ -1,0 +1,413 @@
+#!/usr/bin/env python
+"""Multi-host scale-out bench: 1-process vs 2-process training throughput.
+
+The MULTICHIP series entry for ISSUE 14: spawns real `jax.distributed`
+process groups on forced CPU host devices (the same fleet-stub-style
+subprocess pattern as tests/test_multiprocess.py), trains the tiny RT-1
+policy over a packed per-host-sliced corpus on each topology, and records
+
+* steps/s (post-warmup, resident loop),
+* MFU (XLA cost analysis of the compiled step / measured step time,
+  rt1_tpu/obs/flops.py — peak overridable via RT1_TPU_PEAK_FLOPS),
+* per-host data-stall share (time blocked on the feeder inside the step
+  loop, per process),
+
+for a 1-process x D-device group and a 2-process x D-device group (weak
+scaling: per-host batch fixed, global batch doubles with the host count).
+
+    python scripts/bench_multihost.py --out MULTICHIP_r06.json
+
+Methodology caveats are written INTO the record: on XLA:CPU both "hosts"
+share one physical machine (gloo over loopback, cores oversubscribed), so
+cross-host steps/s is a lower bound and the DCN-overlap story is a TPU
+projection, not a measurement — what the record proves is that the whole
+stack (distributed init, global-order feeder slicing,
+make_array_from_process_local_data placement, dp-crosses-hosts mesh,
+multihost checkpointing) runs end to end and what it costs on this host.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+SEED = 7
+WINDOW = 2
+H, W = 16, 24
+
+
+def _free_port():
+    from rt1_tpu.parallel.distributed import free_local_port
+
+    return free_local_port()
+
+
+# --------------------------------------------------------------- worker
+
+
+def _worker_runtime(nproc: int, devices_per_proc: int):
+    from rt1_tpu.parallel.distributed import force_cpu_multiprocess_runtime
+
+    force_cpu_multiprocess_runtime(devices_per_proc, gloo=nproc > 1)
+
+
+def _build_corpus(data_dir: str, episodes: int) -> str:
+    import numpy as np
+
+    from rt1_tpu.data import episodes as ep_lib
+    from rt1_tpu.data import pack as pack_lib
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(episodes):
+        p = os.path.join(data_dir, f"episode_{i}.npz")
+        ep_lib.save_episode(
+            p,
+            ep_lib.generate_synthetic_episode(
+                rng, num_steps=12, height=H, width=W
+            ),
+        )
+        paths.append(p)
+    pack_dir = os.path.join(data_dir, "packed")
+    pack_lib.pack_episodes(paths, pack_dir, H, W, None)
+    return pack_dir
+
+
+def _tiny_model():
+    from rt1_tpu.models.rt1 import RT1Policy
+    from rt1_tpu.models.tiny_tokenizer import TinyImageTokenizer
+    from rt1_tpu.specs import language_table_action_space
+
+    return RT1Policy(
+        action_space=language_table_action_space(),
+        vocab_size=32,
+        token_embedding_size=16,
+        num_layers=2,
+        layer_size=8,
+        num_heads=2,
+        feed_forward_size=16,
+        dropout_rate=0.0,
+        time_sequence_length=WINDOW,
+        num_image_tokens=2,
+        image_tokenizer_def=TinyImageTokenizer(num_tokens=2, emb=16),
+    )
+
+
+def run_worker(args) -> None:
+    _worker_runtime(args.nproc, args.devices_per_proc)
+    if args.nproc > 1:
+        os.environ["RT1_COORDINATOR"] = f"127.0.0.1:{args.port}"
+        os.environ["RT1_PROCESS_ID"] = str(args.process_id)
+        os.environ["RT1_NUM_PROCESSES"] = str(args.nproc)
+        from rt1_tpu.parallel import initialize_from_config
+
+        assert initialize_from_config(
+            {"parallel": {"distributed": {"enabled": True}}}
+        )
+
+    import jax
+    import numpy as np
+
+    from rt1_tpu.data import pack as pack_lib
+    from rt1_tpu.data.feeder import SampleAheadFeeder
+    from rt1_tpu.data.pipeline import device_feeder
+    from rt1_tpu.obs import flops as flops_lib
+    from rt1_tpu.parallel import ShardingPlan
+    from rt1_tpu.trainer import (
+        create_train_state,
+        make_optimizer,
+        make_train_step_fns,
+    )
+
+    assert jax.process_count() == args.nproc
+
+    # Shared corpus: process 0 packs, others wait on the marker.
+    data_dir = os.path.join(args.workdir, "data")
+    ready = os.path.join(args.workdir, "data_ready")
+    if jax.process_index() == 0:
+        pack_dir = _build_corpus(data_dir, args.episodes)
+        open(ready, "w").close()
+    else:
+        for _ in range(1200):
+            if os.path.exists(ready):
+                break
+            time.sleep(0.05)
+        else:
+            # Falling through silently would open the corpus while rank 0
+            # is still packing it — a torn manifest or, worse, a bench
+            # record over half a corpus.
+            raise TimeoutError(
+                f"rank {jax.process_index()}: corpus marker {ready} never "
+                f"appeared (rank 0 still packing, or it died)"
+            )
+        pack_dir = os.path.join(data_dir, "packed")
+
+    plan = ShardingPlan.from_config({"parallel": {"auto": True}})
+    cache = pack_lib.PackedEpisodeCache(pack_dir, window=WINDOW)
+    feeder = SampleAheadFeeder(
+        cache,
+        args.local_batch,
+        seed=SEED,
+        num_epochs=None,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    model = _tiny_model()
+    first = next(iter(feeder))
+    rng = jax.random.PRNGKey(SEED)
+    host_state = create_train_state(
+        model, rng, (first["observations"], first["actions"]),
+        make_optimizer(steps_per_epoch=100),
+    )
+    fns = make_train_step_fns(
+        model, plan.mesh, host_state, plan=plan, donate=False
+    )
+    state = fns.shard_state(host_state)
+
+    stall = {"s": 0.0}
+
+    def timed_host_stream():
+        yield first
+        while True:
+            t0 = time.perf_counter()
+            batch = next(feeder)
+            stall["s"] += time.perf_counter() - t0
+            yield batch
+
+    dev_iter = device_feeder(timed_host_stream(), fns.batch_sharding, depth=2)
+
+    # Warmup (includes compile), then the timed resident window.
+    for i in range(args.warmup):
+        state, metrics = fns.train_step(
+            state, next(dev_iter), jax.random.fold_in(rng, i)
+        )
+    jax.block_until_ready(metrics["loss"])
+    stall["s"] = 0.0
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = fns.train_step(
+            state, next(dev_iter), jax.random.fold_in(rng, args.warmup + i)
+        )
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    flops = flops_lib.train_step_flops(
+        fns.train_step, state,
+        jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), next(dev_iter)
+        ),
+        jax.ShapeDtypeStruct((2,), "uint32"),
+    )
+    sec_per_step = dt / args.steps
+    result = {
+        "process_id": int(jax.process_index()),
+        "process_count": int(jax.process_count()),
+        "devices_global": int(jax.device_count()),
+        "mesh": {k: int(v) for k, v in plan.mesh.shape.items()},
+        "global_batch": args.local_batch * jax.process_count(),
+        "steps": args.steps,
+        "steps_per_sec": round(args.steps / dt, 3),
+        "sec_per_step": sec_per_step,
+        "examples_per_sec": round(
+            args.local_batch * jax.process_count() * args.steps / dt, 2
+        ),
+        "data_stall_pct": round(100.0 * stall["s"] / dt, 2),
+        "flops_per_step": flops,
+        "mfu_pct": (
+            flops_lib.mfu_pct(flops, sec_per_step, jax.device_count())
+            if flops
+            else None
+        ),
+        "final_loss": float(
+            np.asarray(jax.device_get(metrics["loss"]))
+        ),
+    }
+    feeder.close()
+    out = os.path.join(args.workdir, f"result_{args.process_id}.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+    print(f"worker {args.process_id}/{args.nproc}: {result['steps_per_sec']}"
+          f" steps/s", flush=True)
+
+
+# --------------------------------------------------------------- parent
+
+
+def _run_group(nproc: int, args, workdir: str):
+    import shutil
+
+    # Fresh group dir every run: a stale data_ready marker from a previous
+    # invocation would let rank 1 skip the wait and read the packed corpus
+    # mid-rewrite (torn manifest/mmaps).
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")
+    }
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__), "--worker",
+                "--process_id", str(i), "--nproc", str(nproc),
+                "--port", str(port), "--workdir", workdir,
+                "--steps", str(args.steps), "--warmup", str(args.warmup),
+                "--local_batch", str(args.local_batch),
+                "--devices_per_proc", str(args.devices_per_proc),
+                "--episodes", str(args.episodes),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=args.timeout_s)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"bench_multihost: worker {i}/{nproc} failed:\n{out[-3000:]}"
+            )
+    results = []
+    for i in range(nproc):
+        with open(os.path.join(workdir, f"result_{i}.json")) as f:
+            results.append(json.load(f))
+    head = results[0]
+    return {
+        "processes": nproc,
+        "devices_per_process": args.devices_per_proc,
+        "devices_global": head["devices_global"],
+        "mesh": head["mesh"],
+        "global_batch": head["global_batch"],
+        "steps_per_sec": head["steps_per_sec"],
+        "examples_per_sec": head["examples_per_sec"],
+        "mfu_pct": head["mfu_pct"],
+        "flops_per_step": head["flops_per_step"],
+        "per_host_data_stall_pct": [r["data_stall_pct"] for r in results],
+        "final_loss": head["final_loss"],
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--local_batch", type=int, default=4)
+    p.add_argument("--devices_per_proc", type=int, default=2)
+    p.add_argument("--episodes", type=int, default=8)
+    p.add_argument("--timeout_s", type=int, default=600)
+    p.add_argument("--workdir", default="/tmp/rt1_bench_multihost")
+    p.add_argument("--out", default="MULTICHIP_r06.json")
+    # Worker-mode plumbing (spawned by the parent, not for humans).
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--process_id", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--nproc", type=int, default=1, help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.worker:
+        return run_worker(args)
+
+    groups = {}
+    for nproc in (1, 2):
+        t0 = time.perf_counter()
+        groups[f"{nproc}proc"] = _run_group(
+            nproc, args, os.path.join(args.workdir, f"g{nproc}")
+        )
+        print(
+            f"bench_multihost: {nproc}-process group done in "
+            f"{time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+    g1, g2 = groups["1proc"], groups["2proc"]
+    record = {
+        "bench": "multihost_scaling",
+        "model": "tiny",
+        "seed": SEED,
+        "window": WINDOW,
+        "image_hw": [H, W],
+        "local_batch": args.local_batch,
+        "steps": args.steps,
+        "groups": groups,
+        "scaling": {
+            # Weak scaling: per-host batch fixed, the 2-process group
+            # moves 2x the examples per step.
+            "steps_per_sec_ratio_2p_over_1p": round(
+                g2["steps_per_sec"] / g1["steps_per_sec"], 3
+            ),
+            "examples_per_sec_ratio_2p_over_1p": round(
+                g2["examples_per_sec"] / g1["examples_per_sec"], 3
+            ),
+        },
+        "methodology": {
+            "topology": (
+                f"forced XLA:CPU host devices "
+                f"({args.devices_per_proc}/process), gloo collectives over "
+                f"loopback; 2-process group = 2 hosts x "
+                f"{args.devices_per_proc} devices"
+            ),
+            "timing": (
+                f"one resident loop, {args.warmup} warmup steps (incl. "
+                f"compile) then {args.steps} timed steps, "
+                f"block_until_ready-fenced"
+            ),
+            "mfu": (
+                "XLA cost analysis FLOPs of the lowered step / measured "
+                "step time / (devices x peak); peak = RT1_TPU_PEAK_FLOPS "
+                "or the v5e default — MFU is comparable WITHIN this record, "
+                "not against TPU runs"
+            ),
+            "caveats": (
+                "XLA:CPU: both 'hosts' share one physical machine and pay "
+                "gloo-over-loopback latency for EVERY cross-host "
+                "collective — at tiny-model step times (single-digit ms "
+                "compute) that latency dominates wall time, so the "
+                "2-process steps/s measures the collectives tax, not "
+                "compute scaling, and is a hard LOWER bound on real "
+                "2-host numbers. TPU projection: dp is the only axis "
+                "crossing hosts (AUTO_MESH_SHAPES keeps fsdp x tp "
+                "intra-host), the once-per-step gradient psum overlaps "
+                "with backward compute on DCN, and per-host input "
+                "pipelines are independent, so near-linear examples/s "
+                "weak scaling is expected until the gradient psum stops "
+                "hiding behind compute (flagship-size steps, not tiny)."
+            ),
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+    print(json.dumps(
+        {
+            "bench": "multihost_scaling",
+            "1proc_steps_per_sec": g1["steps_per_sec"],
+            "2proc_steps_per_sec": g2["steps_per_sec"],
+            "examples_per_sec_ratio": record["scaling"][
+                "examples_per_sec_ratio_2p_over_1p"
+            ],
+            "out": args.out,
+        }
+    ))
+    return record
+
+
+if __name__ == "__main__":
+    main()

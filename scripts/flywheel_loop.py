@@ -35,7 +35,6 @@ import json
 import os
 import shutil
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -69,11 +68,9 @@ def _get(url, timeout=10.0):
 
 
 def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from rt1_tpu.parallel.distributed import free_local_port
+
+    return free_local_port()
 
 
 def _read_ready_line(proc, timeout_s=240.0):

@@ -72,6 +72,50 @@ def load_flight(workdir: str) -> Optional[Dict[str, Any]]:
     return recorder.read_dump(path)
 
 
+def load_multichip(
+    workdir: str, explicit: str = ""
+) -> Optional[Dict[str, Any]]:
+    """The newest MULTICHIP_*.json scale-out record in the workdir (or the
+    explicitly named file) — rendered beside the single-host goodput
+    section so 'where the hours went' and 'what scaling out buys' read
+    together. Only `multihost_scaling` records render; older MULTICHIP
+    rounds (dryrun leg matrices) have no throughput table to show."""
+    import glob
+
+    if explicit:
+        # The operator NAMED this file — a typo'd path or a foreign
+        # format must fail loudly, not render as "no record found".
+        try:
+            with open(explicit) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            raise ValueError(
+                f"--multichip {explicit}: unreadable ({exc})"
+            ) from exc
+        if record.get("bench") != "multihost_scaling":
+            raise ValueError(
+                f"--multichip {explicit}: not a multihost_scaling record "
+                f"(bench={record.get('bench')!r}) — produce one with "
+                f"scripts/bench_multihost.py"
+            )
+        record["_path"] = explicit
+        return record
+    for path in sorted(
+        glob.glob(os.path.join(workdir, "MULTICHIP_*.json")),
+        reverse=True,  # newest round first; older rounds are fallback
+    ):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue  # torn/missing file: try the next-older round
+        if record.get("bench") != "multihost_scaling":
+            continue  # pre-ISSUE-14 rounds (dryrun leg matrices)
+        record["_path"] = path
+        return record
+    return None
+
+
 def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
     """Serving artifacts, any subset: SLO summary, loadgen BENCH record,
     slow-request exemplar dump. None when the workdir has none of them
@@ -226,6 +270,55 @@ def render_goodput(goodput: Optional[Dict[str, Any]]) -> List[str]:
         extras.append("run was PREEMPTED (saved and exited 0)")
     if extras:
         lines.append("Events: " + "; ".join(extras) + ".")
+    return lines
+
+
+def render_multichip(record: Optional[Dict[str, Any]]) -> List[str]:
+    """Multi-host scaling beside the goodput story: per-topology steps/s,
+    MFU, and per-host data-stall, plus the weak-scaling ratio and the
+    record's own methodology caveats (an XLA:CPU number without its caveat
+    line is a lie by omission)."""
+    lines = ["## Multi-host scaling (MULTICHIP record)", ""]
+    if record is None:
+        return lines + [
+            "No multihost_scaling MULTICHIP record found — run "
+            "`python scripts/bench_multihost.py` (or `bench.py --mode "
+            "multihost`)."
+        ]
+    lines.append(f"Record: {record.get('_path', '<inline>')}")
+    lines.append("")
+    header = (
+        f"{'group':<8}{'procs':>6}{'devices':>9}{'gbatch':>8}"
+        f"{'steps/s':>10}{'ex/s':>10}{'mfu%':>10}  host data-stall%"
+    )
+    lines.append(header)
+    for name in sorted(record.get("groups", {})):
+        g = record["groups"][name]
+        mfu = g.get("mfu_pct")
+        stalls = ", ".join(
+            f"{s:.1f}" for s in g.get("per_host_data_stall_pct", [])
+        )
+        lines.append(
+            f"{name:<8}{g.get('processes', 0):>6}"
+            f"{g.get('devices_global', 0):>9}{g.get('global_batch', 0):>8}"
+            f"{g.get('steps_per_sec', 0.0):>10.2f}"
+            f"{g.get('examples_per_sec', 0.0):>10.1f}"
+            f"{(f'{mfu:.4f}' if mfu is not None else 'n/a'):>10}"
+            f"  [{stalls}]"
+        )
+    scaling = record.get("scaling", {})
+    if scaling:
+        lines.append("")
+        lines.append(
+            "Weak scaling 2p/1p: "
+            f"steps/s x{scaling.get('steps_per_sec_ratio_2p_over_1p', 0.0)}"
+            ", examples/s x"
+            f"{scaling.get('examples_per_sec_ratio_2p_over_1p', 0.0)}"
+        )
+    caveats = record.get("methodology", {}).get("caveats")
+    if caveats:
+        lines.append("")
+        lines.append(f"Methodology: {caveats}")
     return lines
 
 
@@ -525,6 +618,7 @@ def render_report(
     tail: int = 8,
     serve: Optional[Dict[str, Any]] = None,
     eval_matrix: Optional[Dict[str, Any]] = None,
+    multichip: Optional[Dict[str, Any]] = None,
 ) -> str:
     sections = [
         [f"# RT-1 run report — {workdir}", ""],
@@ -537,9 +631,14 @@ def render_report(
         render_scalars(tb),
         [""],
     ]
-    # Serve / eval-matrix sections only when their artifacts exist: a
-    # training-only workdir keeps its report unchanged (and its golden
-    # tests green).
+    # Serve / eval-matrix / multichip sections only when their artifacts
+    # exist: a training-only workdir keeps its report unchanged (and its
+    # golden tests green).
+    if multichip is not None:
+        # Right after the goodput section — the single-host hours and the
+        # scale-out measurements are one story.
+        sections.insert(2, render_multichip(multichip))
+        sections.insert(2, [""])
     if eval_matrix is not None:
         sections.insert(1, [""])
         sections.insert(1, render_eval_matrix(eval_matrix))
@@ -556,6 +655,10 @@ def main(argv=None):
                    help="Write the report here instead of stdout.")
     p.add_argument("--tail", type=int, default=8,
                    help="Flight-recorder records to show.")
+    p.add_argument("--multichip", default="",
+                   help="Path to a MULTICHIP_*.json scale-out record to "
+                        "render beside the goodput section (default: the "
+                        "newest one in --workdir, if any).")
     args = p.parse_args(argv)
 
     report = render_report(
@@ -566,6 +669,7 @@ def main(argv=None):
         tail=args.tail,
         serve=load_serve(args.workdir),
         eval_matrix=load_eval_matrix(args.workdir),
+        multichip=load_multichip(args.workdir, args.multichip),
     )
     if args.out:
         with open(args.out, "w") as f:

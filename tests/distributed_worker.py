@@ -13,15 +13,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=4"
-).strip()
-
-
 def main():
+    # Forced-CPU multi-device platform + gloo collectives, the shared
+    # scale-out bootstrap (handles the sitecustomize-imports-jax-early
+    # config capture too).
+    from rt1_tpu.parallel.distributed import force_cpu_multiprocess_runtime
+
+    force_cpu_multiprocess_runtime(4)
     process_id = int(sys.argv[1])
     port = sys.argv[2]
     workdir = sys.argv[3]
@@ -154,11 +152,11 @@ def main():
         np.asarray,
         sample_space(language_table_action_space(), rng, (8, t)),
     )
-    # Full (data, seq, model) mesh over both hosts' devices — the sharding
-    # rules name all three axes.
-    train_mesh = Mesh(
-        np.array(jax.devices()).reshape(8, 1, 1), ("data", "seq", "model")
-    )
+    # Full 5-axis mesh over both hosts' devices (the declarative plan's
+    # rules name 'fsdp'/'model'; size-1 axes are free).
+    from rt1_tpu.parallel import MeshConfig, make_mesh
+
+    train_mesh = make_mesh(MeshConfig(data=8))
     repl = NamedSharding(train_mesh, P())
     batch_sh = NamedSharding(train_mesh, P("data"))
 

@@ -8,19 +8,13 @@ the surfaces the reference ran multihost in anger
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from rt1_tpu.parallel.distributed import free_local_port as _free_port
 
 
 @pytest.mark.slow

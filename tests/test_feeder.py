@@ -183,6 +183,110 @@ def test_feeder_rejects_oversized_batch(cache):
         SampleAheadFeeder(cache, len(cache) + 1, start=False)
 
 
+# ------------------------------------------- multi-host slices (ISSUE 14)
+
+
+def test_feeder_host_slices_partition_single_host_stream(cache):
+    """Satellite (ISSUE 14): for process_count ∈ {1, 2, 4} the per-host
+    window streams are a permutation-free partition of the single-host
+    stream — concatenating the hosts' blocks global-batch by global-batch
+    reproduces the single-host order EXACTLY (not merely as a set), and
+    the per-host orders are disjoint and jointly exhaustive over the
+    batched prefix."""
+    global_batch = 4
+    ref = None
+    for pc in (1, 2, 4):
+        feeders = [
+            SampleAheadFeeder(
+                cache, global_batch // pc, seed=11, num_epochs=1,
+                process_index=pi, process_count=pc, start=False,
+            )
+            for pi in range(pc)
+        ]
+        orders = [f.host_order(0) for f in feeders]
+        for f in feeders:
+            f.close()
+        # Disjoint + exhaustive over the batched prefix.
+        union = np.concatenate(orders)
+        assert len(set(union.tolist())) == len(union) == len(cache)
+        # Exact stream: interleave host blocks back into global batches.
+        nb = len(orders[0]) * pc // global_batch
+        merged = (
+            np.stack(
+                [o.reshape(nb, global_batch // pc) for o in orders], axis=1
+            ).reshape(-1)
+        )
+        if ref is None:
+            ref = merged
+        np.testing.assert_array_equal(merged, ref)
+
+
+def test_feeder_host_shards_concat_to_single_host_batch(cache):
+    """Per-host BATCHES (pixels, crops, labels — everything) concatenate
+    to the exact single-host batch: the layout
+    `jax.make_array_from_process_local_data` lays out over a host-major
+    mesh. Augmentation included — each host draws the GLOBAL batch's crop
+    offsets from the shared rng and keeps its rows (pack.fill_batch's
+    `offsets` seam)."""
+    single = list(
+        itertools.islice(
+            SampleAheadFeeder(cache, 4, seed=11, num_epochs=1), 4
+        )
+    )
+    shards = [
+        list(
+            itertools.islice(
+                SampleAheadFeeder(
+                    cache, 2, seed=11, num_epochs=1,
+                    process_index=pi, process_count=2,
+                ),
+                4,
+            )
+        )
+        for pi in range(2)
+    ]
+    for b, want in enumerate(single):
+        got = _tree_concat(shards[0][b], shards[1][b])
+        _batches_equal(got, want)
+
+
+def _tree_concat(a, b):
+    if isinstance(a, dict):
+        return {k: _tree_concat(a[k], b[k]) for k in a}
+    return np.concatenate([a, b])
+
+
+def test_feeder_uniform_batch_count_across_hosts(tmp_path):
+    """Every host sees the SAME per-epoch batch count even when the corpus
+    is not process-divisible — a per-host strided split hands one host an
+    extra batch, which on a real mesh deadlocks the epoch's last
+    collective. 3 episodes × 6 steps = 18 windows, global batch 4: every
+    host must see 4 batches, the 2-window tail dropped on all alike."""
+    rng = np.random.default_rng(3)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"episode_{i}.npz")
+        ep_lib.save_episode(
+            p,
+            ep_lib.generate_synthetic_episode(
+                rng, num_steps=6, height=SRC_H, width=SRC_W
+            ),
+        )
+        paths.append(p)
+    out = str(tmp_path / "packed")
+    pack_lib.pack_episodes(paths, out, H, W, 0.95)
+    c = pack_lib.PackedEpisodeCache(out, window=WINDOW)
+    counts = []
+    for pi in range(2):
+        f = SampleAheadFeeder(
+            c, 2, seed=0, num_epochs=1, process_index=pi, process_count=2,
+            start=False,
+        )
+        counts.append(f.batches_per_epoch)
+        f.close()
+    assert counts == [4, 4]
+
+
 def test_feeder_matches_numpy_loader_without_augmentation(corpus, cache_nocrop):
     """crop_factor None: the feeder's batches equal the existing numpy
     loader's byte-for-byte (same windows, same padding, same labels; images

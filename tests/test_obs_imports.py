@@ -162,6 +162,23 @@ from rt1_tpu.eval.restore import serving_plan
 
 assert serving_plan({"parallel": {}}).mesh.devices.size == 1
 
+# ISSUE 14 plan migration + distributed init: serve replicas restore
+# pod-trained checkpoints through reshard (abstract target templates,
+# host gather->slice fallback) and the distributed options resolve from
+# config/env — all without clu/tensorboard/tensorflow.
+from rt1_tpu.parallel import reshard
+from rt1_tpu.parallel.distributed import DistributedOptions
+
+_tree = {"transformer": {"layer_0": {"ff": {"kernel": _np.ones((4, 4), _np.float32)}}}}
+_tpl = reshard.abstract_target(_tree, plan)
+_leaf = _tpl["transformer"]["layer_0"]["ff"]["kernel"]
+assert _leaf.shape == (4, 4) and _leaf.sharding is not None
+_placed = reshard.place_on_plan(_tree, plan)
+assert reshard.gathered_equal(_placed, _tree)
+_opts = DistributedOptions.from_config({"parallel": {"distributed": {}}})
+assert not _opts.enabled
+_opts.validate()
+
 # ISSUE 9 low-precision serving: the quant mechanics, the parity gate,
 # and the plan's quant rules all run inside serve processes — importable
 # and functional under the blocker (flax/jax allowed; the training stack

@@ -163,7 +163,44 @@ def test_auto_mesh_shape_ladder():
     assert auto_mesh_shape(4) == (2, 2, 1)
     assert auto_mesh_shape(8) == (2, 2, 2)
     assert auto_mesh_shape(16) == (1, 4, 4)
-    assert auto_mesh_shape(64) == (1, 64, 1)  # fallback: pure fsdp
+    assert auto_mesh_shape(32) == (4, 4, 2)
+    assert auto_mesh_shape(64) == (8, 4, 2)
+    assert auto_mesh_shape(96) == (1, 96, 1)  # fallback: pure fsdp
+
+
+def test_auto_mesh_shapes_products_equal_their_keys():
+    """Satellite (ISSUE 14): every table row must cover its device count
+    exactly — a row whose product drifts from its key would make `auto`
+    silently build a mesh over the wrong device subset (the pre-table
+    failure mode was the `(1, n, 1)` fallback flattening pods to pure
+    fsdp)."""
+    from rt1_tpu.parallel import AUTO_MESH_SHAPES
+
+    for n, (dp, fsdp, tp) in AUTO_MESH_SHAPES.items():
+        assert dp * fsdp * tp == n, (
+            f"AUTO_MESH_SHAPES[{n}] = {(dp, fsdp, tp)} has product "
+            f"{dp * fsdp * tp}"
+        )
+
+
+def test_auto_mesh_shape_host_contiguous_rebalance():
+    """Multi-host rows keep fsdp×tp at or below one host's devices (fsdp
+    all-gathers stay on intra-host ICI) by moving factors of 2 from fsdp
+    to dp — the product is preserved and a single-host call is
+    untouched."""
+    for n in (16, 32, 64):
+        for local in (2, 4, 8):
+            dp, fsdp, tp = auto_mesh_shape(n, local)
+            assert dp * fsdp * tp == n
+            # tp is never rebalanced; fsdp shrinks until the model axes
+            # fit in one host (or fsdp is exhausted).
+            assert fsdp * tp <= max(local, tp)
+    assert auto_mesh_shape(16, 8) == (2, 2, 4)
+    assert auto_mesh_shape(32, 8) == (4, 4, 2)  # already host-contiguous
+    assert auto_mesh_shape(64, 4) == (16, 2, 2)
+    # local >= global (single host): the table row verbatim.
+    assert auto_mesh_shape(16, 16) == (1, 4, 4)
+    assert auto_mesh_shape(16, None) == (1, 4, 4)
 
 
 def test_plan_from_config_parallel_block():

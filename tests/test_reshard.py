@@ -1,0 +1,184 @@
+"""Checkpoint plan migration (rt1_tpu/parallel/reshard.py, ISSUE 14).
+
+Acceptance pins:
+
+* a checkpoint saved under the DENSE plan on a forced 4-device mesh
+  restores under FSDP on an 8-device mesh — and back — with bit-identical
+  gathered params (the full TrainState: params, adam moments, step);
+* `eval/restore.py` loads the same big-mesh checkpoint into a 1-device
+  serve engine (train-on-big-mesh → serve-on-small-replicas);
+* the host gather→slice fallback produces the same bytes AND the same
+  target placement as the sharded restore;
+* the module-level `latest_step` scan skips another process's in-progress
+  Orbax tmp dirs and empty step dirs (the single-process half of the
+  CheckpointManager satellite; the two-process half lives in
+  tests/test_multiprocess.py);
+* every save leaves a process-0 `saved_under.json` provenance marker.
+
+conftest forces 8 virtual CPU devices; the 4-device meshes are carved
+from that pool (same GSPMD partitioner and layout machinery as a real
+slice).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from rt1_tpu.parallel import ShardingPlan, reshard
+from rt1_tpu.trainer.checkpoints import (
+    CheckpointConfig,
+    CheckpointManager,
+    latest_step,
+)
+
+
+def _dense_plan_4():
+    return ShardingPlan.from_config(
+        {"parallel": {"dp": 4, "fsdp": 1}}, devices=jax.devices()[:4]
+    )
+
+
+def _fsdp_plan_8():
+    return ShardingPlan.from_config({"parallel": {"dp": 2, "fsdp": 4}})
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    """A real tiny RT-1 TrainState (params + adam moments + step) on host."""
+    from rt1_tpu.eval.restore import build_model_and_state
+    from rt1_tpu.train.configs import tiny
+
+    config = tiny.get_config()
+    _, state, _, _ = build_model_and_state(config)
+    return config, jax.device_get(state)
+
+
+def _mgr(path):
+    return CheckpointManager(
+        CheckpointConfig(directory=str(path), save_interval_steps=1)
+    )
+
+
+def test_dense4_to_fsdp8_round_trip_bit_identical(tmp_path, tiny_state):
+    config, host_state = tiny_state
+    dense, fsdp = _dense_plan_4(), _fsdp_plan_8()
+
+    saved = reshard.place_on_plan(host_state, dense)
+    mgr = _mgr(tmp_path / "ck")
+    assert mgr.save(1, saved)
+    mgr.wait_until_finished()
+
+    migrated = mgr.restore(host_state, step=1, plan=fsdp)
+    # Landed in the TARGET layout: qkv kernels sharded P('fsdp','model')
+    # on the 8-device mesh, and the adam moments follow the same rules.
+    qk = migrated.params["transformer"]["layer_0"]["attn"]["query"]["kernel"]
+    assert qk.sharding.mesh.shape["fsdp"] == 4
+    assert qk.sharding.spec == P("fsdp", "model")
+    mu = migrated.opt_state[0].mu
+    mu_qk = mu["transformer"]["layer_0"]["attn"]["query"]["kernel"]
+    assert mu_qk.sharding.spec == P("fsdp", "model")
+    assert reshard.gathered_equal(migrated, saved)
+
+    # And back: save the fsdp-laid-out state, restore under dense-on-4.
+    assert mgr.save(2, migrated, force=True)
+    mgr.wait_until_finished()
+    back = mgr.restore(host_state, step=2, plan=dense)
+    bk = back.params["transformer"]["layer_0"]["attn"]["query"]["kernel"]
+    assert bk.sharding.mesh.shape["fsdp"] == 1
+    assert reshard.gathered_equal(back, saved)
+    mgr.close()
+
+
+def test_host_fallback_matches_sharded_restore(tmp_path, tiny_state):
+    """gather→slice lands the same bytes in the same target layout as the
+    abstract sharded restore — the path serve hosts (or an Orbax that
+    rejects abstract templates) take."""
+    config, host_state = tiny_state
+    dense, fsdp = _dense_plan_4(), _fsdp_plan_8()
+    mgr = _mgr(tmp_path / "ck")
+    assert mgr.save(1, reshard.place_on_plan(host_state, dense))
+    mgr.wait_until_finished()
+
+    sharded = mgr.restore(host_state, step=1, plan=fsdp)
+    fallback = reshard.place_on_plan(mgr.restore(host_state, step=1), fsdp)
+    assert reshard.gathered_equal(sharded, fallback)
+    shards_a = jax.tree.map(lambda x: str(x.sharding.spec), sharded)
+    shards_b = jax.tree.map(lambda x: str(x.sharding.spec), fallback)
+    assert jax.tree.all(
+        jax.tree.map(lambda a, b: a == b, shards_a, shards_b)
+    )
+    mgr.close()
+
+
+def test_gather_to_host_rejects_nothing_single_process():
+    tree = {"w": jax.device_put(np.ones((4, 2), np.float32))}
+    host = reshard.gather_to_host(tree)
+    assert isinstance(host["w"], np.ndarray)
+
+
+def test_gathered_equal_detects_byte_level_drift():
+    a = {"w": np.zeros((2, 2), np.float32)}
+    b = {"w": np.full((2, 2), -0.0, np.float32)}
+    assert reshard.gathered_equal(a, a)
+    assert not reshard.gathered_equal(a, b)  # -0.0 is a migration bug
+    assert not reshard.gathered_equal(a, {"w": np.zeros((2, 2), np.float64)})
+
+
+def test_serve_engine_loads_big_mesh_checkpoint(tmp_path, tiny_state):
+    """Train-on-big-mesh → serve-on-small-replica: an fsdp-sharded
+    checkpoint loads into a 1-device serve engine with bit-identical
+    params (the acceptance's serve leg)."""
+    from rt1_tpu.eval.restore import build_serve_engine
+
+    config, host_state = tiny_state
+    workdir = tmp_path / "run"
+    mgr = _mgr(workdir / "checkpoints")
+    assert mgr.save(3, reshard.place_on_plan(host_state, _fsdp_plan_8()))
+    mgr.wait_until_finished()
+    mgr.close()
+
+    engine, step = build_serve_engine(
+        config, workdir=str(workdir), max_sessions=2
+    )
+    assert step == 3
+    got = jax.tree.map(np.asarray, engine._variables)
+    assert reshard.gathered_equal(got["params"], host_state.params)
+
+
+def test_latest_step_skips_foreign_tmp_and_empty_dirs(tmp_path):
+    """Another host's in-progress Orbax write must not look like a
+    checkpoint: tmp-suffixed dirs, bare empty step dirs, and stray files
+    are all skipped by the module-level scan AND by restore_or_initialize
+    (which consults Orbax's own finalized-step view)."""
+    mgr = _mgr(tmp_path / "ck")
+    state = {"w": np.arange(6.0).reshape(2, 3)}
+    assert mgr.save(2, state)
+    mgr.wait_until_finished()
+
+    os.makedirs(tmp_path / "ck" / "5.orbax-checkpoint-tmp-1699999999")
+    os.makedirs(tmp_path / "ck" / "7")  # mkdir landed, contents never did
+    (tmp_path / "ck" / "notes.txt").write_text("scratch")
+    assert latest_step(str(tmp_path / "ck")) == 2
+
+    restored, step = mgr.restore_or_initialize(
+        {"w": np.zeros((2, 3))}
+    )
+    assert step == 2
+    np.testing.assert_array_equal(restored["w"], state["w"])
+    mgr.close()
+
+
+def test_save_writes_process0_provenance(tmp_path):
+    mgr = _mgr(tmp_path / "ck")
+    assert mgr.save(4, {"w": np.ones((2, 2))})
+    mgr.wait_until_finished()
+    with open(tmp_path / "ck" / "saved_under.json") as f:
+        prov = json.load(f)
+    assert prov["step"] == 4
+    assert prov["process_count"] == 1
+    assert prov["device_count"] == jax.device_count()
+    mgr.close()

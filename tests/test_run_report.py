@@ -363,6 +363,84 @@ def test_eval_matrix_section_renders_table(tmp_path):
     assert run_report.load_eval_matrix(str(wd)) is None
 
 
+def _canned_multichip(wd):
+    record = {
+        "bench": "multihost_scaling",
+        "groups": {
+            "1proc": {
+                "processes": 1, "devices_global": 2, "global_batch": 4,
+                "mesh": {"data": 2, "fsdp": 1, "model": 1},
+                "steps_per_sec": 240.8, "examples_per_sec": 963.2,
+                "mfu_pct": 0.000127, "per_host_data_stall_pct": [1.7],
+            },
+            "2proc": {
+                "processes": 2, "devices_global": 4, "global_batch": 8,
+                "mesh": {"data": 2, "fsdp": 2, "model": 1},
+                "steps_per_sec": 4.6, "examples_per_sec": 36.8,
+                "mfu_pct": 2.4e-06,
+                "per_host_data_stall_pct": [0.1, 0.2],
+            },
+        },
+        "scaling": {
+            "steps_per_sec_ratio_2p_over_1p": 0.019,
+            "examples_per_sec_ratio_2p_over_1p": 0.038,
+        },
+        "methodology": {"caveats": "XLA:CPU gloo-over-loopback lower bound"},
+    }
+    with open(os.path.join(wd, "MULTICHIP_r06.json"), "w") as f:
+        json.dump(record, f)
+    return record
+
+
+def test_multichip_section_renders_beside_goodput(tmp_path):
+    """ISSUE 14 satellite: the MULTICHIP scale-out record renders right
+    after the goodput section — per-topology steps/s + MFU + per-host
+    data-stall, the weak-scaling ratio, and the record's own caveats."""
+    wd = _canned_workdir(tmp_path)
+    _canned_multichip(wd)
+    record = run_report.load_multichip(wd)
+    assert record is not None
+    report = run_report.render_report(
+        wd,
+        run_report.load_goodput(wd),
+        run_report.load_flight(wd),
+        None,
+        multichip=record,
+    )
+    assert "## Multi-host scaling (MULTICHIP record)" in report
+    # Beside the goodput section: goodput first, scaling right after.
+    assert report.index("Where the hours went") < report.index(
+        "Multi-host scaling"
+    ) < report.index("Flight recorder")
+    assert "1proc" in report and "2proc" in report
+    lines = report.splitlines()
+    row = next(l for l in lines if l.startswith("2proc"))
+    assert "4.60" in row  # steps/s
+    assert "[0.1, 0.2]" in row  # per-host data-stall
+    assert "examples/s x0.038" in report
+    assert "gloo-over-loopback lower bound" in report
+
+
+def test_multichip_loader_ignores_foreign_records(tmp_path):
+    """Pre-ISSUE-14 MULTICHIP rounds (dryrun leg matrices) have no
+    throughput table — the loader returns None instead of rendering a
+    broken section; so do torn/invalid files."""
+    wd = tmp_path / "run"
+    wd.mkdir()
+    with open(wd / "MULTICHIP_r05.json", "w") as f:
+        json.dump({"dryrun_multichip": 8, "legs": {"pp": "ok"}}, f)
+    assert run_report.load_multichip(str(wd)) is None
+    with open(wd / "MULTICHIP_r07.json", "w") as f:
+        f.write('{"bench": "multihost_sc')
+    assert run_report.load_multichip(str(wd)) is None
+    # An EXPLICITLY named path fails loudly instead of degrading to the
+    # "no record found" note — the operator typed it.
+    with pytest.raises(ValueError, match="unreadable"):
+        run_report.load_multichip(str(wd), str(wd / "nope.json"))
+    with pytest.raises(ValueError, match="not a multihost_scaling"):
+        run_report.load_multichip(str(wd), str(wd / "MULTICHIP_r05.json"))
+
+
 def test_serve_section_absent_for_training_only_run(tmp_path):
     """A pure training workdir renders NO serve section — the golden
     training report stays byte-stable."""
