@@ -234,6 +234,39 @@ _SERVE_LABELED_FAMILIES = (
 )
 
 
+# Router-level labeled families (ISSUE 15 elastic fleet): rendered from the
+# router's own snapshot like _SERVE_LABELED_FAMILIES, but deliberately NOT
+# fanned out per replica — scale events, admission sheds, and tier counts
+# are fleet-shape facts that only the router/supervisor process owns.
+_ROUTER_LABELED_FAMILIES = (
+    (
+        "autoscale_scale_events_total",
+        "autoscale_scale_events_total",
+        "counter",
+        "direction",
+        _lexical_label_key,
+        "Fleet scale events by direction (elastic autoscaler).",
+    ),
+    (
+        "autoscale_shed_total",
+        "autoscale_shed_total",
+        "counter",
+        "reason",
+        _lexical_label_key,
+        "Requests shed by router admission control, by reason "
+        "('client_rate' token bucket | 'overload' global threshold).",
+    ),
+    (
+        "autoscale_tier_replicas",
+        "autoscale_tier_replicas",
+        "gauge",
+        "dtype",
+        _lexical_label_key,
+        "Live replicas per dtype capacity tier (base + surge).",
+    ),
+)
+
+
 def render_serve_snapshot(
     snapshot: Dict[str, Any], prefix: str = "rt1_serve_"
 ) -> str:
@@ -275,11 +308,12 @@ def _render_serve_into(
         )
         consumed.update({f"{key}_buckets", f"{key}_sum_s", f"{key}_count"})
     # Labeled-dict families: the per-AOT-bucket occupancy histogram
-    # (`rt1_serve_bucket_batches_total{bucket="4"} 17`, ISSUE 12) and the
+    # (`rt1_serve_bucket_batches_total{bucket="4"} 17`, ISSUE 12), the
     # per-task serve labels (`rt1_serve_task_requests_total{task="play"}`,
-    # ISSUE 13) — each snapshot dict becomes one labeled family.
+    # ISSUE 13), and the router's elastic-fleet families (ISSUE 15) —
+    # each snapshot dict becomes one labeled family.
     for key, family, mtype, label, sort_key, help_text in (
-        _SERVE_LABELED_FAMILIES
+        _SERVE_LABELED_FAMILIES + _ROUTER_LABELED_FAMILIES
     ):
         table = snapshot.get(key)
         if isinstance(table, dict):

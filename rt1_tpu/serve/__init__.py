@@ -14,13 +14,26 @@ backpressure; `server.py` exposes the stdlib HTTP frontend
 throughput in `trainer/metrics.py` writer conventions.
 
 Fleet layer (docs/serving.md "Fleet"): `router.py` routes sessions across
-N replicas with affinity, health-aware placement, bounded failover, and
-rolling reload; `fleet.py` (`python -m rt1_tpu.serve.fleet`) spawns and
-supervises the replica processes with deterministic chaos injection from
-`rt1_tpu/resilience/faults.py`; `stub.py` is the model-free replica double
-the fleet tests and accelerator-less rehearsals run against.
+N replicas with affinity, tier-aware health-aware placement, bounded
+failover, rolling reload, and opt-in admission control
+(`AdmissionController`: per-client token buckets + a global shed
+threshold — overload becomes fast 429s in the `rejected` SLO class);
+`fleet.py` (`python -m rt1_tpu.serve.fleet`) spawns and supervises the
+replica processes with deterministic chaos injection from
+`rt1_tpu/resilience/faults.py` and, with `--min_replicas/--max_replicas`,
+scales the fleet elastically from router-observed signals via the
+hysteretic `autoscale.py` policy (int8 surge tier, graceful
+drain-and-reap, per-dtype replica-second cost ledger — docs/serving.md
+"Elastic fleet"); `stub.py` is the model-free replica double the fleet
+tests and accelerator-less rehearsals run against.
 """
 
+from rt1_tpu.serve.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    FleetSignals,
+    ScaleDecision,
+)
 from rt1_tpu.serve.batcher import (
     BusyError,
     ContinuousBatcher,
@@ -34,7 +47,12 @@ from rt1_tpu.serve.engine import (
     pow2_buckets,
 )
 from rt1_tpu.serve.metrics import LatencyHistogram, ServeMetrics
-from rt1_tpu.serve.router import Replica, Router, make_router_server
+from rt1_tpu.serve.router import (
+    AdmissionController,
+    Replica,
+    Router,
+    make_router_server,
+)
 from rt1_tpu.serve.server import (
     ReloadInProgressError,
     ServeApp,
@@ -44,6 +62,11 @@ from rt1_tpu.serve.server import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "FleetSignals",
+    "ScaleDecision",
     "BusyError",
     "ContinuousBatcher",
     "DrainingError",

@@ -107,6 +107,16 @@ class ServeMetrics:
         # counters always sum to the served-request total.
         self.task_requests_total: Dict[str, int] = {}
         self.task_sessions_total: Dict[str, int] = {}
+        # Elastic fleet (ISSUE 15): scale events by direction, admission
+        # sheds by reason, live replicas per dtype capacity tier, and the
+        # current fleet size. Router-level state — replicas never set
+        # these, so their snapshots (and every pre-elastic dashboard)
+        # are byte-identical. `autoscale_replicas` None = autoscaler off,
+        # the key is absent from the snapshot.
+        self.autoscale_scale_events: Dict[str, int] = {}
+        self.autoscale_shed: Dict[str, int] = {}
+        self.autoscale_tier_replicas: Dict[str, int] = {}
+        self.autoscale_replicas: Optional[int] = None
         self.latency = LatencyHistogram()      # full request wall time
         self.step_latency = LatencyHistogram()  # batched device step only
 
@@ -182,6 +192,53 @@ class ServeMetrics:
                 self.task_sessions_total[key] = (
                     self.task_sessions_total.get(key, 0) + 1
                 )
+
+    def observe_scale_event(self, direction: str) -> None:
+        """One fleet scale event ('up' | 'down'), rendered as the labeled
+        `rt1_serve_autoscale_scale_events_total{direction=}` family."""
+        with self._lock:
+            self.autoscale_scale_events[direction] = (
+                self.autoscale_scale_events.get(direction, 0) + 1
+            )
+
+    def observe_shed(self, reason: str) -> None:
+        """One request shed by router admission control ('client_rate' |
+        'overload'), rendered as `rt1_serve_autoscale_shed_total{reason=}`.
+        Counted in addition to `rejected_total` (the outcome class): the
+        reason label tells WHY load was dropped, the class tells the SLO
+        ledger it was."""
+        with self._lock:
+            self.autoscale_shed[reason] = (
+                self.autoscale_shed.get(reason, 0) + 1
+            )
+
+    def shed_total(self, reason: Optional[str] = None) -> int:
+        """Total admission sheds, optionally for one reason. The
+        autoscaler reads `shed_total("overload")` only: per-client
+        token-bucket sheds ('client_rate') are a policy verdict on one
+        client, not a capacity shortfall — extra replicas cannot fix a
+        rate limit, and counting them as pressure would let a single hot
+        client pin the fleet at max."""
+        with self._lock:
+            if reason is not None:
+                return self.autoscale_shed.get(reason, 0)
+            return sum(self.autoscale_shed.values())
+
+    def set_autoscale_state(
+        self,
+        replicas: Optional[int] = None,
+        tier_replicas: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """Refresh the autoscaler's fleet-shape gauges (set wholesale each
+        tick: `rt1_serve_autoscale_replicas` and the per-dtype
+        `rt1_serve_autoscale_tier_replicas{dtype=}` family)."""
+        with self._lock:
+            if replicas is not None:
+                self.autoscale_replicas = int(replicas)
+            if tier_replicas is not None:
+                self.autoscale_tier_replicas = {
+                    str(k): int(v) for k, v in tier_replicas.items()
+                }
 
     def observe_bucket(self, bucket: int, occupancy: int) -> None:
         """One batch rode the AOT bucket of size `bucket` carrying
@@ -303,6 +360,23 @@ class ServeMetrics:
                     sorted(self.task_sessions_total.items())
                 ),
             }
+            # Elastic-fleet families (router-level): present only once the
+            # autoscaler / admission controller has touched them, so a
+            # plain replica snapshot stays byte-identical to pre-elastic.
+            if self.autoscale_replicas is not None:
+                out["autoscale_replicas"] = self.autoscale_replicas
+            if self.autoscale_scale_events:
+                out["autoscale_scale_events_total"] = dict(
+                    sorted(self.autoscale_scale_events.items())
+                )
+            if self.autoscale_shed:
+                out["autoscale_shed_total"] = dict(
+                    sorted(self.autoscale_shed.items())
+                )
+            if self.autoscale_tier_replicas:
+                out["autoscale_tier_replicas"] = dict(
+                    sorted(self.autoscale_tier_replicas.items())
+                )
             out.update(coerced)
         return out
 

@@ -44,6 +44,20 @@ fleet behaviors on top:
   Prometheus text renders each curated replica field as a labeled family
   (``rt1_serve_replica_*{replica_id="N"}``). `GET /fleet/slow_requests`
   fans out the slow-request exemplar rings the same way.
+* **Admission control** (`AdmissionController`, opt-in). Per-client token
+  buckets plus a global in-flight threshold shed overload as fast 429s
+  in the ``rejected`` outcome class — priced honestly against the SLO
+  ledger (latency objectives judge answered requests only; the per-class
+  burn entries book every shed). Shed reasons ride
+  ``rt1_serve_autoscale_shed_total{reason=}``.
+* **Elastic-fleet hooks.** The autoscaling supervisor (`serve/fleet.py`)
+  reads router-observed signals (`active_session_count` — sessions that
+  acted inside the recency window, `inflight`, the SLO rolling burn) and
+  drives scale-down through `deplace` (stop placement + orphan sessions
+  so they re-home via the existing failover path) and `remove_replica`
+  (purge the reaped id from every map, so `/metrics` and `/fleet/status`
+  never report a ghost). Placement is tier-aware: load first, then the
+  pinned base tier beats quantized surge replicas on ties.
 
 The router carries no model code — stdlib HTTP + `ServeMetrics` only — so
 it stays featherweight next to N jax-heavy replicas (pinned by
@@ -119,9 +133,19 @@ def get_json(url: str, timeout: float) -> Tuple[int, Dict[str, Any]]:
         return 0, {"error": str(exc)}
 
 
+#: Placement preference order for capacity tiers: on a load tie, a new
+#: session lands on the pinned full-precision base tier before a quantized
+#: surge replica — the base tier is the parity canary, surge absorbs
+#: overflow (docs/serving.md "Elastic fleet").
+TIER_BASE = "base"
+TIER_SURGE = "surge"
+_TIER_RANK = {TIER_BASE: 0, TIER_SURGE: 1}
+
+
 class Replica:
     """One serving process as the router tracks it (supervisor-owned
-    fields — proc, restarts — are written by serve/fleet.py)."""
+    fields — proc, restarts, tier, dtype, spawned_at — are written by
+    serve/fleet.py)."""
 
     def __init__(self, replica_id: int, url: Optional[str] = None, proc=None):
         self.id = replica_id
@@ -131,6 +155,14 @@ class Replica:
         self.state = STARTING
         self.restarts = 0  # times the supervisor respawned this slot
         self.consecutive_probe_failures = 0
+        # Elastic-fleet capacity tiering: the initial fleet is the pinned
+        # "base" tier; autoscaler-spawned surge replicas are "surge"
+        # (typically quantized — int8 replicas are ~3.71x cheaper in
+        # device param bytes, BENCH_serve_quant.json). `dtype` and
+        # `spawned_at` feed the replica-second cost accounting.
+        self.tier = TIER_BASE
+        self.dtype: Optional[str] = None
+        self.spawned_at: Optional[float] = None
 
     def summary(self) -> Dict[str, Any]:
         return {
@@ -138,6 +170,109 @@ class Replica:
             "url": self.url,
             "state": self.state,
             "restarts": self.restarts,
+            "tier": self.tier,
+            "dtype": self.dtype,
+        }
+
+
+class AdmissionController:
+    """Router-side admission control: per-client token buckets + a global
+    overload threshold, so overload produces fast ``rejected`` 429s
+    instead of blown p99s.
+
+    * **Token bucket per client id** (`client_id` payload field, else the
+      session id): `rate_per_client` tokens/s refill up to `burst`; an
+      /act with no token is shed with reason ``client_rate``. One hot
+      client cannot starve the fleet.
+    * **Global shed threshold**: when more than `max_inflight` requests
+      are simultaneously mid-route through the router, new arrivals shed
+      with reason ``overload`` — the fleet is saturated fleet-wide and a
+      queued request would only blow the answered-request p99.
+
+    Shedding is priced honestly: every 429 lands in the SLO ledger's
+    ``rejected`` class (which burns error budget per-class) and the
+    latency objectives are judged on answered requests only — a fleet
+    cannot "fix" its p99 by shedding (`rt1_tpu/obs/slo.py`).
+
+    Stdlib-only and clock-injectable (tests drive a fake monotonic
+    clock). Zero `rate_per_client` disables the per-client bucket, zero
+    `max_inflight` disables the global threshold — both default off, so
+    a router without an admission config behaves exactly as before.
+    """
+
+    def __init__(
+        self,
+        rate_per_client: float = 0.0,
+        burst: float = 8.0,
+        max_inflight: int = 0,
+        max_clients: int = 65536,
+        clock=time.monotonic,
+    ):
+        if rate_per_client < 0 or burst < 1.0:
+            # burst < 1 would mean no bucket ever reaches a whole token:
+            # every client's every request shed, forever — a total
+            # lockout, not a rate limit.
+            raise ValueError(
+                f"rate_per_client must be >= 0 and burst >= 1, got "
+                f"{rate_per_client}/{burst}"
+            )
+        self.rate_per_client = rate_per_client
+        self.burst = burst
+        self.max_inflight = max_inflight
+        self.max_clients = max_clients
+        self._clock = clock
+        self._lock = threading.Lock()
+        # client id -> [tokens, last_refill]; LRU-bounded (a bucket is
+        # two floats, so the 64k default costs ~6 MB worst case). A
+        # client that went quiet long enough to be evicted re-enters
+        # with a full bucket — exactly what its refill would have
+        # reached. Limitation, stated honestly: with MORE simultaneously
+        # active clients than max_clients, hot clients get continuously
+        # evicted-and-refilled and the per-client rate stops binding;
+        # size max_clients above the concurrent client population, and
+        # rely on `max_inflight` as the id-cycling/overload backstop
+        # (an adversary minting fresh client ids defeats any per-client
+        # bucket by construction).
+        self._buckets: collections.OrderedDict = collections.OrderedDict()
+
+    def reject_reason(self, client_id: str, inflight: int) -> Optional[str]:
+        """None = admitted; otherwise the shed-reason label. Checked (and
+        the token spent) once per routed /act, before placement."""
+        if self.max_inflight > 0 and inflight > self.max_inflight:
+            return "overload"
+        if self.rate_per_client <= 0:
+            return None
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get(client_id)
+            if bucket is None:
+                bucket = [self.burst, now]
+                self._buckets[client_id] = bucket
+                while len(self._buckets) > self.max_clients:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(client_id)
+            tokens, last = bucket
+            tokens = min(
+                self.burst, tokens + (now - last) * self.rate_per_client
+            )
+            if tokens < 1.0:
+                bucket[0] = tokens
+                bucket[1] = now
+                return "client_rate"
+            bucket[0] = tokens - 1.0
+            bucket[1] = now
+            return None
+
+    def gauges(self) -> Dict[str, float]:
+        """Token-bucket gauges for the router's /metrics merge."""
+        with self._lock:
+            tracked = len(self._buckets)
+        return {
+            "admission_clients_tracked": float(tracked),
+            "admission_rate_per_client": self.rate_per_client,
+            "admission_burst": self.burst,
+            "admission_max_inflight": float(self.max_inflight),
         }
 
 
@@ -154,6 +289,7 @@ class Router:
         metrics: Optional[ServeMetrics] = None,
         slo: Optional[SLOLedger] = None,
         metrics_probe_timeout_s: float = 3.0,
+        admission: Optional[AdmissionController] = None,
     ):
         self._lock = threading.RLock()
         self._replicas: Dict[int, Replica] = {}
@@ -176,6 +312,18 @@ class Router:
         # outcome class; gauges ride /metrics, GET /slo has the verdict.
         self.slo = slo if slo is not None else SLOLedger(SLOObjectives())
         self.metrics_probe_timeout_s = metrics_probe_timeout_s
+        # Admission control (ISSUE 15): None keeps the pre-elastic router
+        # byte-identical — every request is admitted.
+        self.admission = admission
+        # Elastic-fleet occupancy signal: session id -> monotonic time of
+        # its last answered act, recency-ordered. The affinity map counts
+        # every session the router ever placed; the autoscaler needs the
+        # sessions that are actually TALKING — active_session_count()
+        # walks this from most-recent until it falls out of the window.
+        self._act_times: collections.OrderedDict = collections.OrderedDict()
+        # Requests currently mid-route (the router-side queue-depth
+        # analogue): an autoscale signal and the global-shed input.
+        self._inflight = 0
         self.draining = False
 
     # ------------------------------------------------------------ registry
@@ -215,6 +363,34 @@ class Router:
         del reason  # kept for call-site readability / future logging
         self.set_state(replica.id, DEAD)
 
+    def deplace(self, replica_id: int) -> None:
+        """Scale-down drain, step one: stop placing on the replica
+        (NOTREADY — its own /readyz will report 503 once it drains) and
+        orphan its sessions NOW so their next act re-homes through the
+        existing failover path with ``restarted: true``. The replica keeps
+        answering whatever is already in flight; the supervisor reaps the
+        process only after this and a drain grace."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None:
+                return
+            replica.state = NOTREADY
+            self._orphan_sessions_locked(replica_id)
+
+    def remove_replica(self, replica_id: int) -> Optional[Replica]:
+        """Scale-down reclaim, final step: purge the reaped replica from
+        the routing table entirely. Unlike a DEAD replica (which the
+        supervisor will respawn into the same slot), a removed replica is
+        GONE: `/fleet/status`, the `/metrics` fan-out, and the
+        `rt1_serve_replica_*` labeled families stop reporting its id —
+        dropped, not zeroed (a ghost `replica_up 0` forever would read as
+        a permanently-failing probe, not a deliberate reclaim)."""
+        with self._lock:
+            replica = self._replicas.pop(replica_id, None)
+            if replica is not None:
+                self._orphan_sessions_locked(replica_id)
+            return replica
+
     def _orphan_session(self, session_id: str, replica_id: int) -> None:
         """Re-home ONE session (replica slow or mid-respawn): unmap it and
         flag the restart, leaving its neighbors' state intact."""
@@ -236,7 +412,17 @@ class Router:
         loads = {rid: 0 for rid in self._replicas}
         for rid in self._sessions.values():
             loads[rid] = loads.get(rid, 0) + 1
-        best = min(ready, key=lambda r: (loads.get(r.id, 0), r.id))
+        # Tier-aware least-loaded: load first (surge capacity absorbs
+        # genuine overflow), then the pinned base tier on ties (the
+        # full-precision parity canary keeps serving the steady state).
+        best = min(
+            ready,
+            key=lambda r: (
+                loads.get(r.id, 0),
+                _TIER_RANK.get(r.tier, 0),
+                r.id,
+            ),
+        )
         self._sessions[session_id] = best.id
         self._sessions.move_to_end(session_id)
         while len(self._sessions) > self.max_tracked_sessions:
@@ -282,16 +468,23 @@ class Router:
         """
         request_id = reqtrace.request_id_from(headers, payload)
         t0 = time.perf_counter()
-        with obs_trace.span(
-            "router_route",
-            request_id=request_id,
-            session=payload.get("session_id"),
-        ):
-            status, body = self._route_act_inner(payload, request_id)
+        with self._lock:
+            self._inflight += 1
+        try:
+            with obs_trace.span(
+                "router_route",
+                request_id=request_id,
+                session=payload.get("session_id"),
+            ):
+                status, body = self._route_act_inner(payload, request_id)
+        finally:
+            with self._lock:
+                self._inflight -= 1
         body.setdefault("request_id", request_id)
         elapsed = time.perf_counter() - t0
         if status == 200 and "error" not in body:
             outcome = "restarted" if body.get("restarted") else "ok"
+            self._note_act(payload.get("session_id"))
             # Router-side per-task labels under the single-replica family
             # names (the PR 8 convention): fleet-wide task totals on the
             # router scrape, per-replica splits in the aggregated
@@ -301,12 +494,45 @@ class Router:
                 task if isinstance(task, str) else None,
                 new_session=body.get("session_started", False),
             )
-        elif status == 503:
+        elif status in (429, 503):
+            # 429 = admission-control shed, 503 = backpressure/no-capacity
+            # shed; both are the `rejected` outcome class, priced against
+            # the error budget per-class by the SLO ledger.
             outcome = "rejected"
         else:
             outcome = "failed"
         self.slo.observe(outcome, elapsed)
         return status, body
+
+    def _note_act(self, session_id) -> None:
+        """Record an answered act for the occupancy signal (recency
+        order; bounded alongside the affinity map)."""
+        if not isinstance(session_id, str):
+            return
+        with self._lock:
+            self._act_times[session_id] = time.monotonic()
+            self._act_times.move_to_end(session_id)
+            while len(self._act_times) > self.max_tracked_sessions:
+                self._act_times.popitem(last=False)
+
+    def active_session_count(self, window_s: float) -> int:
+        """Sessions that acted within the last `window_s` seconds — the
+        autoscaler's occupancy numerator. A session that went quiet stops
+        counting when the window passes it, even though its affinity-map
+        entry (and its replica-side slot) still exists."""
+        cutoff = time.monotonic() - window_s
+        count = 0
+        with self._lock:
+            for _, t in reversed(self._act_times.items()):
+                if t < cutoff:
+                    break  # recency-ordered: everything older is stale too
+                count += 1
+        return count
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
 
     def _route_act_inner(
         self, payload: Dict[str, Any], request_id: str
@@ -316,6 +542,27 @@ class Router:
             return 400, {"error": "'session_id' must be a non-empty string"}
         if self.draining:
             return 503, {"error": "draining"}
+        if self.admission is not None:
+            # Admission control BEFORE placement: a shed request must be
+            # fast (no replica hop) and cheap (no affinity mutation). The
+            # client id defaults to the session id; a client running many
+            # sessions can declare `client_id` to share one bucket.
+            client = payload.get("client_id")
+            reason = self.admission.reject_reason(
+                client if isinstance(client, str) and client else session_id,
+                self.inflight,
+            )
+            if reason is not None:
+                self.metrics.observe_shed(reason)
+                return 429, {
+                    "error": f"admission control shed this request "
+                    f"({reason})",
+                    "reason": reason,
+                    # Explicitly NOT retry:true — the client should back
+                    # off, not hammer the token bucket (contrast the
+                    # transient 503 busy path).
+                    "retry": False,
+                }
         fwd_headers = {reqtrace.REQUEST_ID_HEADER: request_id}
         last_error = "no ready replicas"
         for _ in range(self.max_failovers + 1):
@@ -380,6 +627,10 @@ class Router:
                 rid = self._sessions.pop(session_id, None)
                 was_orphaned = session_id in self._orphaned
                 self._orphaned.discard(session_id)
+                # A released session is done talking: drop it from the
+                # occupancy signal NOW (an orphaned session stays counted
+                # — its client is alive and about to re-home).
+                self._act_times.pop(session_id, None)
                 replica = self._replicas.get(rid) if rid is not None else None
             if replica is None or replica.state == DEAD:
                 # Never-seen is a client error; a session whose replica
@@ -469,7 +720,7 @@ class Router:
             states: Dict[str, int] = {}
             for replica in self._replicas.values():
                 states[replica.state] = states.get(replica.state, 0) + 1
-            return {
+            out = {
                 "replicas_total": len(self._replicas),
                 "replicas_ready": states.get(READY, 0),
                 "replicas_dead": states.get(DEAD, 0),
@@ -480,7 +731,11 @@ class Router:
                 ),
                 "draining": int(self.draining),
                 "ready": int(states.get(READY, 0) > 0),
+                "router_inflight": self._inflight,
             }
+        if self.admission is not None:
+            out.update(self.admission.gauges())
+        return out
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Router-own counters + fleet gauges + the SLO ledger's
@@ -673,10 +928,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         if self.path == "/act":
             status, body = self.router.route_act(payload, self.headers)
-            if status == 503:
-                # Shed load (no ready replicas / failover budget) is the
-                # rejected counter, not errors_total — same split the
-                # single-replica server makes for its busy 503s.
+            if status in (429, 503):
+                # Shed load (admission 429, no-ready-replicas / failover
+                # 503) is the rejected counter, not errors_total — same
+                # split the single-replica server makes for its busy 503s.
                 self.router.metrics.observe_rejected()
             else:
                 self.router.metrics.observe_request(

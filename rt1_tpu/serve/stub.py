@@ -67,6 +67,7 @@ class StubReplicaApp:
         inference_dtype: str = "f32",
         buckets=None,
         scheduler: str = "continuous",
+        act_concurrency: int = 0,
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
@@ -82,6 +83,17 @@ class StubReplicaApp:
         # lets tier-1 prove mixed-dtype fleet aggregation with no jax.
         self.inference_dtype = inference_dtype
         self.act_delay_s = act_delay_s
+        # Elastic rehearsals (ISSUE 15): a real replica's device serializes
+        # its batched steps, so replica count moves latency under load.
+        # `act_concurrency > 0` mimics that — at most N simulated device
+        # steps run at once per stub, the rest queue (and their queue wait
+        # lands in the latency histogram, as it would on a real replica).
+        # 0 = unlimited, the legacy fully-concurrent behavior.
+        self._device_gate = (
+            threading.BoundedSemaphore(act_concurrency)
+            if act_concurrency > 0
+            else None
+        )
         self.reload_delay_s = reload_delay_s
         self.metrics = ServeMetrics()
         self.exemplars = ExemplarRing(threshold_ms=slow_threshold_ms)
@@ -149,14 +161,21 @@ class StubReplicaApp:
         phases.t_enqueue = obs_trace.now_us()
         phases.t_formed = obs_trace.now_us()
         phases.t_device0 = obs_trace.now_us()
-        with reqtrace.device_step_span(1, [phases.request_id]):
-            if self.act_delay_s:
-                time.sleep(self.act_delay_s)  # inside the timer: the
-                #   latency histogram must reflect the simulated step cost
-            with self._lock:
-                started = session_id not in self._sessions
-                step = self._sessions.get(session_id, 0)
-                self._sessions[session_id] = step + 1
+        if self._device_gate is not None:
+            self._device_gate.acquire()  # simulated device: steps serialize
+        try:
+            with reqtrace.device_step_span(1, [phases.request_id]):
+                if self.act_delay_s:
+                    time.sleep(self.act_delay_s)  # inside the timer: the
+                    #   latency histogram must reflect the simulated step
+                    #   cost (and, gated, the queue wait for the device)
+                with self._lock:
+                    started = session_id not in self._sessions
+                    step = self._sessions.get(session_id, 0)
+                    self._sessions[session_id] = step + 1
+        finally:
+            if self._device_gate is not None:
+                self._device_gate.release()
         phases.t_device1 = obs_trace.now_us()
         self.metrics.observe_request(time.perf_counter() - t0)
         self.metrics.observe_batch(1, queued=0)
@@ -372,6 +391,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--act_delay_s", type=float, default=0.0,
         help="Simulated device-step latency per /act.")
+    parser.add_argument(
+        "--act_concurrency", type=int, default=0,
+        help="Serialize at most N simulated device steps at once "
+             "(elastic-fleet rehearsals; 0 = unlimited).")
     parser.add_argument("--reload_delay_s", type=float, default=0.05)
     parser.add_argument(
         "--slow_threshold_ms", type=float, default=0.0,
@@ -404,8 +427,21 @@ def main(argv=None) -> int:
         inference_dtype=args.inference_dtype,
         buckets=[int(b) for b in args.buckets.split(",") if b.strip()],
         scheduler=args.scheduler,
+        act_concurrency=args.act_concurrency,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
+    # Graceful drain on SIGTERM — the same contract the real replica's
+    # install_signal_handlers provides, so a scale-down reclaim (router
+    # de-placement -> SIGTERM -> reap) finishes in-flight acts and exits
+    # 0 instead of dying rc=-15 mid-response. ThreadingHTTPServer's
+    # block_on_close joins the in-flight handler threads in server_close.
+    import signal as _signal
+
+    def _drain(signum, frame):  # noqa: ARG001 - signal signature
+        app.draining = True
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    _signal.signal(_signal.SIGTERM, _drain)
     if args.startup_delay_s:
         app.ready = False
 

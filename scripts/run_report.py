@@ -147,6 +147,13 @@ def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
                 out["quant_bench"] = json.load(f)
         except (json.JSONDecodeError, OSError):
             pass
+    path = os.path.join(workdir, "BENCH_serve_elastic.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                out["elastic_bench"] = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            pass  # half-written record from a killed A/B
     path = os.path.join(workdir, "slow_requests.jsonl")
     if os.path.exists(path):
         try:
@@ -471,8 +478,15 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     slo = serve.get("slo") if serve else None
     bench = serve.get("bench") if serve else None
     quant = serve.get("quant_bench") if serve else None
+    elastic = serve.get("elastic_bench") if serve else None
     exemplars = serve.get("exemplars") if serve else None
-    if slo is None and bench is None and exemplars is None and quant is None:
+    if (
+        slo is None
+        and bench is None
+        and exemplars is None
+        and quant is None
+        and elastic is None
+    ):
         lines.append(
             "No serving artifacts (slo_summary.json / BENCH_serve_*.json / "
             "slow_requests.jsonl) in the workdir."
@@ -578,6 +592,8 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
         note = quant.get("honesty_note")
         if note:
             lines.append(f"Note: {note}")
+    if elastic is not None:
+        lines.extend(_render_elastic(elastic))
     records = (exemplars or {}).get("records", [])
     if exemplars is not None:
         header = exemplars.get("header", {})
@@ -607,6 +623,80 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
                     + (f"{d:>10.2f}" if d is not None else f"{'-':>10}")
                     + f"  {rec.get('outcome', '?')}"
                 )
+    return lines
+
+
+def _render_elastic(elastic: Dict[str, Any]) -> List[str]:
+    """The elastic-fleet A/B (BENCH_serve_elastic.json): per-phase
+    latency/replica table per side, the scale-event timeline, and the
+    cost-per-request comparison the autoscaler exists to win."""
+    lines = [""]
+    lines.append(
+        f"Elastic fleet (BENCH_serve_elastic.json): cost-per-request "
+        f"ratio fixed-max/elastic {elastic.get('value', 0)}x on the "
+        f"{elastic.get('headline_schedule', '?')} schedule "
+        f"({elastic.get('min_replicas', '?')}.."
+        f"{elastic.get('max_replicas', '?')} replicas, surge dtype "
+        f"{elastic.get('surge_dtype') or 'base'}, "
+        f"{elastic.get('requests_failed', '?')} failed requests)."
+    )
+    sides = elastic.get("sides") or {}
+    for schedule in elastic.get("schedules", []):
+        lines.append("")
+        lines.append(
+            f"{'[' + schedule + ']':<12}{'side':<12}{'phase':<12}"
+            f"{'clients':>8}{'req/s':>9}{'p50 ms':>9}{'p99 ms':>9}"
+            f"{'shed':>6}{'fail':>6}{'repl':>6}"
+        )
+        for side in ("elastic", "fixed_max"):
+            rec = (sides.get(side) or {}).get(schedule) or {}
+            for row in rec.get("phases", []):
+                lines.append(
+                    f"{'':<12}{side:<12}{row.get('phase', '?'):<12}"
+                    f"{row.get('clients', 0):>8}"
+                    f"{row.get('req_per_sec', 0.0):>9.1f}"
+                    f"{row.get('latency_p50_ms', 0.0):>9.2f}"
+                    f"{row.get('latency_p99_ms', 0.0):>9.2f}"
+                    f"{row.get('requests_rejected', 0):>6}"
+                    f"{row.get('requests_failed', 0):>6}"
+                    f"{row.get('replicas_after', '?'):>6}"
+                )
+        events = (
+            (sides.get("elastic") or {}).get(schedule) or {}
+        ).get("scale_events", [])
+        if events:
+            lines.append("  Scale events (elastic side):")
+            for e in events:
+                lines.append(
+                    f"    t={e.get('t_s', 0.0):>7.1f}s "
+                    f"{e.get('direction', '?'):<5} replica "
+                    f"{e.get('replica_id', '?')} "
+                    f"({e.get('dtype') or '?'}): "
+                    f"{e.get('reason', '?')}"
+                )
+        cost = (elastic.get("cost_per_request") or {}).get(schedule) or {}
+        seconds_e = (
+            (sides.get("elastic") or {}).get(schedule) or {}
+        ).get("replica_seconds_by_dtype") or {}
+        seconds_f = (
+            (sides.get("fixed_max") or {}).get(schedule) or {}
+        ).get("replica_seconds_by_dtype") or {}
+        lines.append(
+            f"  Cost/request (byte-weighted replica-seconds): elastic "
+            f"{cost.get('elastic')} vs fixed-max {cost.get('fixed_max')} "
+            f"(replica-s by dtype: elastic {seconds_e or '?'}, "
+            f"fixed {seconds_f or '?'})."
+        )
+        env = (elastic.get("p99_peak_phase") or {}).get(schedule)
+        if env:
+            verdict = (
+                "within" if env.get("within_envelope") else "OUTSIDE"
+            )
+            lines.append(
+                f"  Peak-phase p99: elastic {env.get('elastic_ms')} ms vs "
+                f"fixed-max {env.get('fixed_max_ms')} ms — {verdict} the "
+                f"{env.get('envelope_factor')}x envelope."
+            )
     return lines
 
 

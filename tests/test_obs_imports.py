@@ -101,6 +101,44 @@ assert stub12.healthz()["buckets"] == [1, 2, 4]
 assert stub12.healthz()["scheduler"] == "continuous"
 assert stub12.metrics_snapshot()["bucket_count"] == 3
 
+# ISSUE 15 elastic fleet: the autoscaler decision module and the router's
+# admission controller both run inside the model-free router/supervisor
+# process — stdlib-only by contract, and the new autoscale/admission
+# metric families render through the same snapshot->text path.
+from rt1_tpu.serve.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    FleetSignals,
+)
+
+policy15 = AutoscalePolicy(
+    min_replicas=1, max_replicas=3, up_sustain_ticks=1,
+    up_cooldown_ticks=0)
+scaler15 = Autoscaler(policy15)
+decision15 = scaler15.decide(FleetSignals(
+    replicas_total=1, replicas_ready=1, active_sessions=4,
+    session_slots=2))
+assert decision15 is not None and decision15.direction == "up"
+
+from rt1_tpu.serve.router import AdmissionController
+
+clock15 = {"t": 0.0}
+adm15 = AdmissionController(
+    rate_per_client=1.0, burst=1.0, clock=lambda: clock15["t"])
+assert adm15.reject_reason("c", 0) is None
+assert adm15.reject_reason("c", 0) == "client_rate"
+assert adm15.gauges()["admission_clients_tracked"] == 1.0
+
+m15 = ServeMetrics()
+m15.observe_scale_event("up")
+m15.observe_shed("client_rate")
+m15.set_autoscale_state(replicas=2, tier_replicas={"f32": 1, "int8": 1})
+text15 = m15.prometheus_text()
+assert 'rt1_serve_autoscale_scale_events_total{direction="up"} 1' in text15
+assert 'rt1_serve_autoscale_shed_total{reason="client_rate"} 1' in text15
+assert 'rt1_serve_autoscale_tier_replicas{dtype="int8"} 1' in text15
+assert "rt1_serve_autoscale_replicas 2" in text15
+
 # PR 8 serving-observability pieces: the SLO ledger, the shared
 # percentile helpers, the request tracer, and the exemplar ring all run
 # in the router / replica processes — stdlib + obs only.

@@ -589,6 +589,110 @@ def test_eval_matrix_gauge_naming():
                                         "checkpoint": "3900"}, "0") in samples2
 
 
+def test_autoscale_and_admission_families_naming_contract():
+    """ISSUE 15 naming contract: the elastic-fleet families render under
+    their promised names — `rt1_serve_autoscale_replicas`,
+    `rt1_serve_autoscale_scale_events_total{direction=}`,
+    `rt1_serve_autoscale_shed_total{reason=}`,
+    `rt1_serve_autoscale_tier_replicas{dtype=}` — plus the router
+    token-bucket gauges, same numbers through JSON and text; and a plain
+    replica snapshot (no autoscaler) carries NONE of them."""
+    metrics = ServeMetrics()
+    metrics.observe_scale_event("up")
+    metrics.observe_scale_event("up")
+    metrics.observe_scale_event("down")
+    metrics.observe_shed("client_rate")
+    metrics.observe_shed("overload")
+    metrics.observe_shed("client_rate")
+    metrics.set_autoscale_state(
+        replicas=3, tier_replicas={"f32": 1, "int8": 2}
+    )
+    assert metrics.shed_total() == 3
+
+    snap = metrics.snapshot(
+        admission_clients_tracked=4,
+        admission_rate_per_client=5.0,
+        admission_burst=8.0,
+        admission_max_inflight=32,
+        router_inflight=2,
+    )
+    assert snap["autoscale_replicas"] == 3
+    assert snap["autoscale_scale_events_total"] == {"down": 1, "up": 2}
+    assert snap["autoscale_shed_total"] == {"client_rate": 2, "overload": 1}
+    assert snap["autoscale_tier_replicas"] == {"f32": 1, "int8": 2}
+
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_autoscale_replicas"] == "gauge"
+    assert types["rt1_serve_autoscale_scale_events_total"] == "counter"
+    assert types["rt1_serve_autoscale_shed_total"] == "counter"
+    assert types["rt1_serve_autoscale_tier_replicas"] == "gauge"
+    assert types["rt1_serve_admission_clients_tracked"] == "gauge"
+    assert (
+        "rt1_serve_autoscale_scale_events_total",
+        {"direction": "up"},
+        "2",
+    ) in samples
+    assert (
+        "rt1_serve_autoscale_scale_events_total",
+        {"direction": "down"},
+        "1",
+    ) in samples
+    assert (
+        "rt1_serve_autoscale_shed_total",
+        {"reason": "client_rate"},
+        "2",
+    ) in samples
+    assert (
+        "rt1_serve_autoscale_tier_replicas",
+        {"dtype": "int8"},
+        "2",
+    ) in samples
+    assert ("rt1_serve_autoscale_replicas", {}, "3") in samples
+    assert ("rt1_serve_router_inflight", {}, "2") in samples
+    assert ("rt1_serve_admission_rate_per_client", {}, "5") in samples
+
+    # A replica (or any pre-elastic snapshot) is untouched: no autoscale
+    # keys in JSON, no autoscale families in text.
+    plain = ServeMetrics().snapshot()
+    assert not any(k.startswith("autoscale") for k in plain)
+    plain_text = prom.render_serve_snapshot(plain)
+    assert "autoscale" not in plain_text
+
+    # The autoscale families are ROUTER-level: the per-replica fan-out
+    # never grows rt1_serve_replica_autoscale_* names, even if a replica
+    # snapshot somehow carried the dicts.
+    assert not any(
+        "autoscale" in name for name in prom.fleet_metric_names()
+    )
+
+
+def test_router_elastic_gauges_ride_the_scrape():
+    """A router with admission armed exposes the token-bucket gauges and
+    (after autoscaler ticks) the fleet-shape families on its own
+    /metrics path — stdlib-only, same snapshot→text contract."""
+    from rt1_tpu.serve.router import AdmissionController, Router
+
+    router = Router(
+        admission=AdmissionController(rate_per_client=2.0, burst=4.0)
+    )
+    router.metrics.set_autoscale_state(
+        replicas=2, tier_replicas={"f32": 1, "int8": 1}
+    )
+    snap = router.metrics_snapshot()
+    assert snap["admission_rate_per_client"] == 2.0
+    assert snap["admission_burst"] == 4.0
+    assert snap["router_inflight"] == 0
+    assert snap["autoscale_replicas"] == 2
+    text = router.metrics_prometheus()
+    assert "rt1_serve_admission_clients_tracked 0" in text
+    assert 'rt1_serve_autoscale_tier_replicas{dtype="int8"} 1' in text
+    # Admission off (the default): none of the admission gauges appear —
+    # pre-elastic router scrapes are byte-compatible.
+    bare = Router().metrics_snapshot()
+    assert not any(k.startswith("admission") for k in bare)
+
+
 def test_family_label_escaping():
     exp = prom.TextExposition()
     exp.family(

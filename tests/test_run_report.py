@@ -298,6 +298,125 @@ def test_serve_quant_bench_renders_dtype_table(tmp_path):
     assert report.index("SLO met.") < report.index("int8 param-byte")
 
 
+def test_serve_elastic_bench_renders_timeline_and_cost(tmp_path):
+    """ISSUE 15: a BENCH_serve_elastic.json in the workdir renders as the
+    per-phase A/B table, the scale-event timeline, and the cost-per-
+    request comparison (with the p99-envelope verdict); a workdir without
+    one keeps its report elastic-free."""
+    wd = _canned_serve_workdir(tmp_path)
+    elastic = {
+        "metric": "serve_elastic_cost_ratio_fixed_over_elastic",
+        "value": 2.004,
+        "unit": "x",
+        "headline_schedule": "diurnal",
+        "schedules": ["diurnal"],
+        "min_replicas": 1,
+        "max_replicas": 3,
+        "surge_dtype": "int8",
+        "requests_failed": 0,
+        "p99_peak_phase": {
+            "diurnal": {
+                "elastic_ms": 43.2,
+                "fixed_max_ms": 46.0,
+                "envelope_factor": 1.5,
+                "within_envelope": True,
+            }
+        },
+        "cost_per_request": {
+            "diurnal": {"elastic": 0.010016, "fixed_max": 0.02007}
+        },
+        "sides": {
+            "elastic": {
+                "diurnal": {
+                    "phases": [
+                        {
+                            "phase": "night", "clients": 2,
+                            "req_per_sec": 67.5, "latency_p50_ms": 15.8,
+                            "latency_p99_ms": 28.4, "requests_rejected": 0,
+                            "requests_failed": 0, "replicas_after": 1,
+                        },
+                        {
+                            "phase": "midday", "clients": 10,
+                            "req_per_sec": 255.7, "latency_p50_ms": 26.4,
+                            "latency_p99_ms": 43.2, "requests_rejected": 3,
+                            "requests_failed": 0, "replicas_after": 3,
+                        },
+                    ],
+                    "scale_events": [
+                        {
+                            "t_s": 4.6, "direction": "up",
+                            "replica_id": 1, "dtype": "int8",
+                            "reason": "occupancy 1.75 >= 0.75",
+                        },
+                        {
+                            "t_s": 18.4, "direction": "down",
+                            "replica_id": 1, "dtype": "int8",
+                            "reason": "occupancy 0.17 <= 0.30 for 4 ticks",
+                        },
+                    ],
+                    "replica_seconds_by_dtype": {
+                        "f32": 18.9, "int8": 27.3
+                    },
+                }
+            },
+            "fixed_max": {
+                "diurnal": {
+                    "phases": [
+                        {
+                            "phase": "night", "clients": 2,
+                            "req_per_sec": 74.7, "latency_p50_ms": 14.6,
+                            "latency_p99_ms": 20.5, "requests_rejected": 0,
+                            "requests_failed": 0, "replicas_after": 3,
+                        },
+                    ],
+                    "replica_seconds_by_dtype": {"f32": 57.1},
+                }
+            },
+        },
+    }
+    with open(os.path.join(wd, "BENCH_serve_elastic.json"), "w") as f:
+        json.dump(elastic, f)
+    serve = run_report.load_serve(wd)
+    assert serve["elastic_bench"]["value"] == 2.004
+    report = run_report.render_report(wd, None, None, None, serve=serve)
+    assert (
+        "cost-per-request ratio fixed-max/elastic 2.004x on the diurnal "
+        "schedule" in report
+    )
+    assert "1..3 replicas, surge dtype int8, 0 failed requests" in report
+    lines = report.splitlines()
+    # Per-phase rows for both sides, replicas column included.
+    midday = next(
+        ln for ln in lines if "elastic" in ln and "midday" in ln
+    )
+    assert "255.7" in midday and midday.rstrip().endswith("3")
+    night_fixed = next(
+        ln for ln in lines if "fixed_max" in ln and "night" in ln
+    )
+    assert night_fixed.rstrip().endswith("3")
+    # The scale-event timeline, up and down, with dtype + reason.
+    assert (
+        "t=    4.6s up    replica 1 (int8): occupancy 1.75 >= 0.75"
+        in report
+    )
+    assert "t=   18.4s down  replica 1 (int8)" in report
+    # Cost + envelope verdicts.
+    assert (
+        "Cost/request (byte-weighted replica-seconds): elastic 0.010016 "
+        "vs fixed-max 0.02007" in report
+    )
+    assert (
+        "Peak-phase p99: elastic 43.2 ms vs fixed-max 46.0 ms — within "
+        "the 1.5x envelope." in report
+    )
+    # A workdir without the record keeps its report elastic-free.
+    bare = run_report.render_report(
+        wd, None, None, None,
+        serve={"slo": serve["slo"]},
+    )
+    assert "Elastic fleet" not in bare
+
+
 def test_eval_matrix_section_renders_table(tmp_path):
     """ISSUE 13: a BENCH_eval_matrix.json in the workdir renders as a
     task × checkpoint success table (plus the oracle-fill note); a

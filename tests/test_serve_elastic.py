@@ -1,0 +1,593 @@
+"""Elastic serving fleet tier-1 (ISSUE 15): autoscaler hysteresis units,
+router admission-control units, and the stub-fleet integration cycle —
+ramp load scales 1→3 (surge tier at int8), dropped load drains back to 1
+with zero failed requests, sessions on reclaimed replicas re-home through
+the failover path, reaped replica ids vanish from every scrape (no
+ghosts), and an admission-controlled spike sheds with fast 429s (never a
+5xx) that the SLO ledger books as per-class `rejected` burn.
+
+The integration tests use the model-free stub (`rt1_tpu/serve/stub.py`)
+exactly like tests/test_serve_fleet.py: real subprocesses, real HTTP,
+real spawn/drain/reap — only the model is absent, so the whole scale
+cycle runs in seconds with zero jax boots.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rt1_tpu.serve.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    FleetSignals,
+)
+from rt1_tpu.serve.fleet import DTYPE_COST_WEIGHTS, FleetSupervisor
+from rt1_tpu.serve.router import (
+    READY,
+    TIER_SURGE,
+    AdmissionController,
+    Router,
+    make_router_server,
+)
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+import serve_loadgen  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def _stub_argv(replica_id: int, dtype=None):
+    return [
+        sys.executable, "-m", "rt1_tpu.serve.stub",
+        "--port", "0",
+        "--replica_id", str(replica_id),
+        "--inference_dtype", dtype or "f32",
+    ]
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            body = resp.read()
+            try:
+                return resp.status, json.loads(body)
+            except json.JSONDecodeError:
+                return resp.status, body.decode("utf-8")
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _act(url, session_id, task=None):
+    payload = {
+        "session_id": session_id,
+        "image_b64": "AAAA",
+        "instruction": "x",
+    }
+    if task:
+        payload["task"] = task
+    return _post(url + "/act", payload)
+
+
+def _sig(total, ready, active, slots, **kw):
+    return FleetSignals(
+        replicas_total=total,
+        replicas_ready=ready,
+        active_sessions=active,
+        session_slots=slots,
+        **kw,
+    )
+
+
+# ------------------------------------------------------- autoscaler units
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=2, max_replicas=1)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(
+            min_replicas=1, max_replicas=2,
+            scale_up_occupancy=0.5, scale_down_occupancy=0.5,
+        )  # no hysteresis band
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0, max_replicas=2)
+
+
+def test_autoscaler_scales_up_only_after_sustained_pressure():
+    p = AutoscalePolicy(
+        min_replicas=1, max_replicas=3,
+        up_sustain_ticks=2, down_sustain_ticks=3,
+        up_cooldown_ticks=0, down_cooldown_ticks=0,
+    )
+    a = Autoscaler(p)
+    hot = _sig(1, 1, 4, 2)  # occupancy 2.0
+    assert a.decide(hot) is None  # tick 1: streak building
+    decision = a.decide(hot)  # tick 2: sustained
+    assert decision is not None and decision.direction == "up"
+    assert "occupancy" in decision.reason
+
+    # A one-tick blip never scales: the band tick resets the streak.
+    b = Autoscaler(p)
+    assert b.decide(hot) is None
+    assert b.decide(_sig(1, 1, 1, 2)) is None  # 0.5: hysteresis band
+    assert b.decide(hot) is None  # streak restarted at 1
+
+
+def test_autoscaler_down_is_slower_and_clamped():
+    p = AutoscalePolicy(
+        min_replicas=1, max_replicas=3,
+        up_sustain_ticks=2, down_sustain_ticks=3,
+        up_cooldown_ticks=0, down_cooldown_ticks=0,
+    )
+    a = Autoscaler(p)
+    cold = _sig(2, 2, 0, 4)
+    assert a.decide(cold) is None
+    assert a.decide(cold) is None
+    decision = a.decide(cold)  # third idle tick
+    assert decision is not None and decision.direction == "down"
+    # Clamped at the floor: the same idleness at min_replicas holds.
+    b = Autoscaler(p)
+    at_min = _sig(1, 1, 0, 2)
+    for _ in range(6):
+        assert b.decide(at_min) is None
+    # Clamped at the ceiling: sustained pressure at max holds.
+    c = Autoscaler(p)
+    at_max = _sig(3, 3, 12, 6)
+    for _ in range(6):
+        assert c.decide(at_max) is None
+
+
+def test_autoscaler_one_boot_at_a_time_and_cooldown():
+    p = AutoscalePolicy(
+        min_replicas=1, max_replicas=4,
+        up_sustain_ticks=1, down_sustain_ticks=2,
+        up_cooldown_ticks=2, down_cooldown_ticks=0,
+    )
+    a = Autoscaler(p)
+    # A warming boot (STARTING replica) blocks every decision...
+    warming = _sig(2, 1, 8, 2, replicas_booting=1)
+    for _ in range(4):
+        assert a.decide(warming) is None
+    # ...but a lingering NOTREADY replica (alive HTTP, 503 forever —
+    # total != ready with NO boot in flight) must NOT wedge the
+    # autoscaler: overload still scales up.
+    stuck = Autoscaler(p)
+    not_ready_pressure = _sig(2, 1, 8, 2)
+    assert stuck.decide(not_ready_pressure).direction == "up"
+    # Once ready, the sustained streak fires immediately...
+    hot = _sig(2, 2, 8, 4)
+    assert a.decide(hot).direction == "up"
+    # ...and the cooldown holds the next two ticks.
+    assert a.decide(hot) is None
+    assert a.decide(hot) is None
+    assert a.decide(hot).direction == "up"
+
+
+def test_autoscaler_shed_and_burn_are_pressure():
+    p = AutoscalePolicy(
+        min_replicas=1, max_replicas=3,
+        up_sustain_ticks=1, down_sustain_ticks=2,
+        up_cooldown_ticks=0, burn_pressure=2.0,
+    )
+    a = Autoscaler(p)
+    shed = _sig(1, 1, 0, 2, shed_delta=3)
+    decision = a.decide(shed)
+    assert decision is not None and "shed" in decision.reason
+    b = Autoscaler(p)
+    burning = _sig(1, 1, 1, 4, rolling_burn=5.0)  # active traffic + burn
+    decision = b.decide(burning)
+    assert decision is not None and "burn" in decision.reason
+    # A FROZEN burn reading (no live traffic — the request-indexed
+    # rolling window can never dilute) is evidence about the past, not
+    # pressure: without this, one shed burst pins the fleet at max
+    # forever and scale-down never fires.
+    c = Autoscaler(p)
+    stale_burn = _sig(2, 2, 0, 4, rolling_burn=15.0)
+    assert c.decide(stale_burn) is None  # idle tick 1, not pressure
+    decision = c.decide(stale_burn)  # idle tick 2 -> down
+    assert decision is not None and decision.direction == "down"
+    # Saturated signal: traffic with zero ready slots is infinite
+    # occupancy, i.e. pressure, not a crash.
+    assert _sig(1, 0, 3, 0).occupancy == float("inf")
+
+
+# ---------------------------------------------------- admission controller
+
+
+def test_admission_token_bucket_per_client():
+    clock = {"t": 0.0}
+    adm = AdmissionController(
+        rate_per_client=1.0, burst=2.0, clock=lambda: clock["t"]
+    )
+    assert adm.reject_reason("alice", 0) is None
+    assert adm.reject_reason("alice", 0) is None  # burst of 2
+    assert adm.reject_reason("alice", 0) == "client_rate"
+    # Other clients have their own bucket.
+    assert adm.reject_reason("bob", 0) is None
+    # Refill: 1 token/s.
+    clock["t"] = 1.0
+    assert adm.reject_reason("alice", 0) is None
+    assert adm.reject_reason("alice", 0) == "client_rate"
+    gauges = adm.gauges()
+    assert gauges["admission_clients_tracked"] == 2.0
+    assert gauges["admission_rate_per_client"] == 1.0
+    assert gauges["admission_burst"] == 2.0
+
+
+def test_admission_global_overload_threshold():
+    adm = AdmissionController(max_inflight=2)
+    assert adm.reject_reason("c", 2) is None  # at the threshold: admit
+    assert adm.reject_reason("c", 3) == "overload"
+    # rate 0 = per-client bucket off entirely.
+    for _ in range(50):
+        assert adm.reject_reason("c", 0) is None
+    with pytest.raises(ValueError):
+        AdmissionController(rate_per_client=-1.0)
+    with pytest.raises(ValueError):
+        # burst < 1 = no bucket ever holds a whole token: total lockout.
+        AdmissionController(rate_per_client=1.0, burst=0.5)
+
+
+def test_admission_client_map_is_bounded():
+    adm = AdmissionController(rate_per_client=1.0, burst=1.0, max_clients=4)
+    for i in range(10):
+        adm.reject_reason(f"client-{i}", 0)
+    assert adm.gauges()["admission_clients_tracked"] <= 4
+
+
+# ------------------------------------------------------------ task mix
+
+
+def test_parse_task_mix_patterns():
+    assert serve_loadgen.parse_task_mix("blocktoblock:3,separate:1") == [
+        "blocktoblock", "blocktoblock", "blocktoblock", "separate",
+    ]
+    # Task slugs may contain ':' themselves (canonical unknown:<name>).
+    assert serve_loadgen.parse_task_mix("unknown:play:2") == [
+        "unknown:play", "unknown:play",
+    ]
+    assert serve_loadgen.parse_task_mix("unknown:play") == ["unknown:play"]
+    assert serve_loadgen.parse_task_mix("solo") == ["solo"]
+    assert serve_loadgen.parse_task_mix("") == []
+    with pytest.raises(ValueError):
+        serve_loadgen.parse_task_mix(":3")
+
+
+def test_build_schedule_shapes():
+    for name in serve_loadgen.SCHEDULE_NAMES:
+        phases = serve_loadgen.build_schedule(name, 2, 10, 3.0)
+        assert phases[0][1] == 2  # every schedule starts at trough
+        assert max(c for _, c, _ in phases) == 10
+        # Uniform phase length, except the spike's half-length leading
+        # edge (the window a reactive autoscaler reacts within).
+        assert all(
+            d == (1.5 if label == "edge" else 3.0)
+            for label, _, d in phases
+        )
+    spike = serve_loadgen.build_schedule("spike", 2, 10, 3.0)
+    assert [label for label, _, _ in spike] == [
+        "pre", "edge", "spike", "post",
+    ]
+    with pytest.raises(ValueError):
+        serve_loadgen.build_schedule("sawtooth", 2, 10, 3.0)
+
+
+# ------------------------------------------------- stub-fleet integration
+
+
+@pytest.fixture
+def elastic_fleet():
+    """One base stub replica behind a router with the autoscaler armed
+    (1..3, int8 surge tier, fast ticks) and admission control available
+    but effectively open (high limits) so the scale cycle is clean."""
+    policy = AutoscalePolicy(
+        min_replicas=1,
+        max_replicas=3,
+        scale_up_occupancy=0.75,
+        scale_down_occupancy=0.30,
+        up_sustain_ticks=2,
+        down_sustain_ticks=3,
+        up_cooldown_ticks=1,
+        down_cooldown_ticks=1,
+        active_window_s=1.0,
+    )
+    router = Router(replica_timeout_s=10.0)
+    supervisor = FleetSupervisor(
+        router,
+        _stub_argv,
+        1,
+        poll_interval_s=0.05,
+        chaos_interval_s=3600.0,  # no chaos in the elastic cycle
+        warmup_timeout_s=60.0,
+        autoscale=policy,
+        autoscale_interval_s=0.15,
+        max_sessions=2,
+        surge_dtype="int8",
+        base_dtype_fn=lambda _i: "f32",
+        reclaim_grace_s=0.2,
+    )
+    supervisor.start(wait_ready=True)
+    httpd = make_router_server(router, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield router, supervisor, url
+    httpd.shutdown()
+    httpd.server_close()
+    thread.join(timeout=5)
+    supervisor.stop()
+
+
+def _wait_until(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_elastic_scale_up_down_cycle(elastic_fleet):
+    """The tentpole acceptance on stubs: ramp → 1→3 with int8 surge
+    replicas, drop → drain back to 1 with 0 failed requests, sessions on
+    reclaimed replicas re-home (restarted flag, fresh window), reaped ids
+    purged from /metrics (JSON + text) and /fleet/status — and the
+    rt1_serve_autoscale_* families tell the story on the same scrape."""
+    router, supervisor, url = elastic_fleet
+    statuses = []
+    statuses_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(i):
+        while not stop.is_set():
+            status, _body = _act(url, f"wave1-{i}")
+            with statuses_lock:
+                statuses.append(status)
+            time.sleep(0.01)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # Ramp: 6 active sessions over 1 ready replica x 2 slots is
+        # occupancy 3.0 — sustained pressure scales 1 → 2 → 3.
+        _wait_until(
+            lambda: router.ready_count() == 3, 25.0, "scale-up to 3 ready"
+        )
+        assert supervisor.scale_ups >= 2
+        surge = [r for r in router.replicas() if r.tier == TIER_SURGE]
+        assert len(surge) == 2
+        assert all(r.dtype == "int8" for r in surge)
+        assert all(r.id >= 1 for r in surge)  # fresh ids, never reused
+
+        # Second wave: new sessions place least-loaded, i.e. onto the
+        # surge replicas (wave 1 sits affine on replica 0).
+        wave2_home = {}
+        for i in range(4):
+            status, body = _act(url, f"wave2-{i}")
+            assert status == 200
+            wave2_home[f"wave2-{i}"] = body["replica_id"]
+        assert any(rid != 0 for rid in wave2_home.values())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    # Every ramp request was answered 200 — scaling is invisible to
+    # clients (0 failed, 0 shed on an open admission config).
+    assert statuses and set(statuses) == {200}
+
+    # Drop: the active window empties, sustained idleness drains the
+    # surge tier back to the pinned base replica.
+    _wait_until(
+        lambda: len(router.replicas()) == 1
+        and router.ready_count() == 1,
+        30.0,
+        "drain back to 1 replica",
+    )
+    assert supervisor.scale_downs >= 2
+    assert router.replicas()[0].id == 0  # the base canary survives
+    down_events = [
+        e for e in supervisor.scale_events if e["direction"] == "down"
+    ]
+    assert len(down_events) >= 2
+    # Reclaim victims were drained gracefully (SIGTERM exit 0, not a
+    # kill) and their compile evidence was snapshotted pre-reap.
+    for event in down_events:
+        assert event["exit_code"] == 0
+        assert event["compile_count"] == event["bucket_count"] == 1
+
+    # In-flight sessions re-home through the existing failover path:
+    # wave-2 sessions lived on reclaimed surge replicas — their next act
+    # is a 200 with restarted:true and a fresh window, never a 5xx.
+    rehomed = 0
+    for sid, home in wave2_home.items():
+        status, body = _act(url, sid)
+        assert status == 200, body
+        assert body["replica_id"] == 0
+        if home != 0:
+            assert body["restarted"] is True
+            assert body["step_index"] == 0
+            rehomed += 1
+    assert rehomed >= 1
+
+    # Ghost purge (satellite): reaped ids are gone from every surface —
+    # dropped, not zeroed.
+    status, fleet_status = _get(url + "/fleet/status")
+    assert [r["id"] for r in fleet_status["replicas"]] == [0]
+    status, metrics = _get(url + "/metrics")
+    assert set(metrics["replicas"].keys()) == {"0"}
+    assert metrics["autoscale_replicas"] == 1
+    assert metrics["autoscale_scale_events_total"]["up"] >= 2
+    assert metrics["autoscale_scale_events_total"]["down"] >= 2
+    assert metrics["autoscale_tier_replicas"] == {"f32": 1}
+    status, text = _get(
+        url + "/metrics", headers={"Accept": "text/plain"}
+    )
+    assert 'rt1_serve_replica_up{replica_id="0"} 1' in text
+    for ghost in ("1", "2"):
+        assert f'replica_id="{ghost}"' not in text
+    assert (
+        'rt1_serve_autoscale_scale_events_total{direction="up"}' in text
+    )
+    assert (
+        'rt1_serve_autoscale_scale_events_total{direction="down"}' in text
+    )
+    assert 'rt1_serve_autoscale_tier_replicas{dtype="f32"} 1' in text
+    assert "rt1_serve_autoscale_replicas 1" in text
+
+    # Cost accounting: both tiers accrued replica-seconds, and the cost
+    # weights price the int8 surge tier below f32.
+    seconds = supervisor.replica_seconds_by_dtype()
+    assert seconds["f32"] > 0 and seconds["int8"] > 0
+    summary = supervisor.autoscale_summary()
+    assert summary["enabled"] is True
+    assert 0 < summary["cost_units"] < sum(seconds.values())
+    assert DTYPE_COST_WEIGHTS["int8"] < DTYPE_COST_WEIGHTS["f32"]
+
+
+@pytest.fixture
+def admission_fleet():
+    """One stub replica behind a router with a tight per-client token
+    bucket — the spike-shed rehearsal."""
+    router = Router(
+        replica_timeout_s=10.0,
+        admission=AdmissionController(rate_per_client=5.0, burst=3.0),
+    )
+    supervisor = FleetSupervisor(
+        router,
+        _stub_argv,
+        1,
+        poll_interval_s=0.1,
+        chaos_interval_s=3600.0,
+        warmup_timeout_s=60.0,
+    )
+    supervisor.start(wait_ready=True)
+    httpd = make_router_server(router, host="127.0.0.1", port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield router, url
+    httpd.shutdown()
+    httpd.server_close()
+    thread.join(timeout=5)
+    supervisor.stop()
+
+
+def test_admission_spike_sheds_with_429(admission_fleet):
+    """Spike through a tight token bucket: overload becomes fast 429s in
+    the `rejected` class (retry:false, request id echoed) — never a 5xx
+    — and the SLO ledger books the burn per-class."""
+    router, url = admission_fleet
+    codes = []
+    bodies_429 = []
+    for step in range(40):
+        status, body = _act(url, "blaster")
+        codes.append(status)
+        if status == 429:
+            bodies_429.append(body)
+    assert set(codes) <= {200, 429}
+    assert codes.count(429) > 0, "the token bucket never shed"
+    assert codes.count(200) >= 3  # the burst was admitted
+    for body in bodies_429:
+        assert body["reason"] == "client_rate"
+        assert body["retry"] is False
+        assert body["request_id"]  # the shed request is quotable
+
+    # Other clients are untouched by the blaster's empty bucket.
+    status, _ = _act(url, "bystander")
+    assert status == 200
+
+    # Honest pricing: every shed is a `rejected` outcome with per-class
+    # error-budget burn; latency objectives judge answered requests only.
+    gauges = router.slo.gauges()
+    assert gauges["slo_requests_rejected"] == float(codes.count(429))
+    assert gauges["slo_requests_failed"] == 0.0
+    assert gauges["slo_error_budget_burn"] > 0.0
+    summary = router.slo.summary()
+    assert summary["by_class"]["rejected"]["error_budget_burn"] > 0.0
+
+    # The shed-reason family + token-bucket gauges ride the same scrape.
+    snapshot = router.metrics_snapshot()
+    assert snapshot["autoscale_shed_total"]["client_rate"] == codes.count(
+        429
+    )
+    assert snapshot["rejected_total"] == codes.count(429)
+    assert snapshot["admission_clients_tracked"] >= 1
+    assert snapshot["admission_rate_per_client"] == 5.0
+    text = router.metrics_prometheus()
+    assert 'rt1_serve_autoscale_shed_total{reason="client_rate"}' in text
+    assert "rt1_serve_admission_clients_tracked" in text
+
+
+# ------------------------------------------------------------ slow e2e
+
+
+@pytest.mark.slow
+def test_elastic_bench_real_replicas(tmp_path):
+    """The BENCH_serve_elastic.json producer end to end with REAL jax
+    replicas on the tiny config: one spike schedule, elastic 1..2 vs
+    fixed 2, zero failed requests, compile_count pinned at bucket_count
+    on every lifetime (surge boot included)."""
+    import subprocess
+
+    output = tmp_path / "bench_elastic.json"
+    cmd = [
+        sys.executable,
+        os.path.join(REPO, "scripts", "serve_loadgen.py"),
+        "--traffic_schedule", "spike",
+        "--config", os.path.join(REPO, "rt1_tpu/train/configs/tiny.py"),
+        "--min_replicas", "1",
+        "--max_replicas", "2",
+        "--schedule_base_sessions", "2",
+        "--schedule_peak_sessions", "8",
+        "--phase_duration", "30",
+        "--autoscale_interval_s", "1.0",
+        "--active_window_s", "5.0",
+        "--think_time", "0.02",
+        "--session_cycle_steps", "20",
+        "--fleet_warmup_timeout_s", "600",
+        "--log_dir", str(tmp_path / "logs"),
+        "--output", str(output),
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=900, cwd=REPO, env=env
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout}\nstderr: {proc.stderr[-3000:]}"
+    )
+    result = json.loads(output.read_text())
+    assert result["requests_failed"] == 0
+    assert result["compile_pinned_at_bucket_count"] is True
+    elastic = result["sides"]["elastic"]["spike"]
+    assert elastic["requests_ok"] > 0
