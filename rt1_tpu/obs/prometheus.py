@@ -405,6 +405,31 @@ _FLEET_REPLICA_FIELDS = {
 }
 
 
+# Router-attributed per-replica SLO families (deploy canary judgement):
+# rendered from Router.replica_slo_snapshot(), NOT the replica /metrics
+# fan-out — the router is the only process that sees every outcome,
+# including the death that the dead replica itself could never report.
+_REPLICA_SLO_FAMILIES = (
+    (
+        "outcome_total",
+        "counter",
+        "Router-attributed request outcomes per replica "
+        "(ok | restarted | rejected | failed).",
+    ),
+    (
+        "slo_availability_rolling",
+        "gauge",
+        "Rolling ok-fraction of requests this replica answered.",
+    ),
+    (
+        "slo_error_budget_burn_rolling",
+        "gauge",
+        "Rolling error-budget burn attributed to this replica "
+        "(the canary rollback signal).",
+    ),
+)
+
+
 def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
     """Every family name the aggregated fleet exposition can emit (the
     naming-contract test iterates this)."""
@@ -413,6 +438,8 @@ def fleet_metric_names(prefix: str = "rt1_serve_") -> List[str]:
         names.append(prefix + "replica_" + _gauge_suffix(key))
     for _, family, _, _, _, _ in _SERVE_LABELED_FAMILIES:
         names.append(prefix + "replica_" + family)
+    for suffix, _, _ in _REPLICA_SLO_FAMILIES:
+        names.append(prefix + "replica_" + suffix)
     return names
 
 
@@ -420,6 +447,7 @@ def render_fleet_snapshot(
     router_snapshot: Dict[str, Any],
     replicas: Dict[Any, Optional[Dict[str, Any]]],
     prefix: str = "rt1_serve_",
+    replica_slo: Optional[Dict[Any, Dict[str, Any]]] = None,
 ) -> str:
     """Router snapshot + per-replica snapshots -> ONE exposition body.
 
@@ -428,7 +456,9 @@ def render_fleet_snapshot(
     fleet); each replica's curated fields follow as labeled families with
     a ``replica_id`` label. A replica whose `/metrics` probe failed
     (value None) appears only in ``replica_up`` as 0 — absence of data is
-    itself a scraped fact, not a silent gap.
+    itself a scraped fact, not a silent gap. ``replica_slo``
+    (`Router.replica_slo_snapshot()`) adds the router-attributed
+    per-replica outcome families — the canary burn signal.
     """
     exp = TextExposition()
     _render_serve_into(exp, router_snapshot, prefix)
@@ -496,6 +526,40 @@ def render_fleet_snapshot(
         exp.family(
             prefix + "replica_" + family, mtype, samples, help_text
         )
+    # Router-attributed per-replica SLO families (the canary judgement
+    # view): outcome-class counters double-labeled {replica_id, outcome}
+    # plus the rolling availability/burn gauge pair per replica.
+    if replica_slo:
+        ordered = sorted(replica_slo.items(), key=lambda kv: str(kv[0]))
+        outcome_samples = [
+            ({"replica_id": str(rid), "outcome": str(o)}, count)
+            for rid, entry in ordered
+            for o, count in entry.get("outcomes", {}).items()
+        ]
+        families = {
+            key: [
+                ({"replica_id": str(rid)}, entry[field])
+                for rid, entry in ordered
+                if isinstance(entry.get(field), (int, float))
+            ]
+            for key, field in (
+                ("slo_availability_rolling", "availability_rolling"),
+                (
+                    "slo_error_budget_burn_rolling",
+                    "error_budget_burn_rolling",
+                ),
+            )
+        }
+        for suffix, mtype, help_text in _REPLICA_SLO_FAMILIES:
+            samples = (
+                outcome_samples
+                if suffix == "outcome_total"
+                else families[suffix]
+            )
+            if samples:
+                exp.family(
+                    prefix + "replica_" + suffix, mtype, samples, help_text
+                )
     return exp.render()
 
 

@@ -37,7 +37,17 @@ fleet behaviors on top:
 * **SLO ledger.** Every routed request lands in one outcome class
   (ok / restarted / rejected / failed — `rt1_tpu/obs/slo.py`); the
   ledger's availability / error-budget-burn gauges ride `/metrics` as
-  ``rt1_serve_slo_*`` and `GET /slo` returns the full judgement.
+  ``rt1_serve_slo_*`` and `GET /slo` returns the full judgement. Each
+  outcome is ALSO attributed to the replica that answered (or died
+  answering), so one replica's burn — the canary question — is
+  distinguishable from the fleet's: per-replica ledgers ride
+  `/fleet/status` (``slo`` sub-dict), the JSON `/metrics` fan-out
+  (``replica_slo``), and Prometheus text
+  (``rt1_serve_replica_outcome_total{replica_id=,outcome=}`` plus
+  per-replica rolling availability/burn gauges). Outcomes no replica
+  produced — admission sheds, no-capacity 503s, exhausted failover —
+  stay fleet-wide only: blaming a replica for a request it never saw
+  would poison a canary verdict.
 * **Fleet metrics aggregation.** The router's `/metrics` fans out to
   every live replica's `/metrics` and merges the snapshots into ONE
   scrape target: JSON carries a ``replicas`` map keyed by replica id,
@@ -79,7 +89,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
-from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
+from rt1_tpu.obs.slo import OUTCOMES, SLOLedger, SLOObjectives
 from rt1_tpu.serve import reqtrace
 from rt1_tpu.serve.metrics import ServeMetrics
 
@@ -311,6 +321,12 @@ class Router:
         # The fleet's judge: every routed /act lands in exactly one
         # outcome class; gauges ride /metrics, GET /slo has the verdict.
         self.slo = slo if slo is not None else SLOLedger(SLOObjectives())
+        # Per-replica attribution of the same outcome stream: one ledger
+        # per replica that has ever answered (or died answering) an /act,
+        # created lazily with the fleet ledger's objectives. A removed
+        # replica's ledger is dropped with it (`remove_replica` — same
+        # dropped-not-zeroed contract as the metrics fan-out).
+        self._replica_slo: Dict[int, SLOLedger] = {}
         self.metrics_probe_timeout_s = metrics_probe_timeout_s
         # Admission control (ISSUE 15): None keeps the pre-elastic router
         # byte-identical — every request is admitted.
@@ -389,6 +405,7 @@ class Router:
             replica = self._replicas.pop(replica_id, None)
             if replica is not None:
                 self._orphan_sessions_locked(replica_id)
+            self._replica_slo.pop(replica_id, None)
             return replica
 
     def _orphan_session(self, session_id: str, replica_id: int) -> None:
@@ -476,7 +493,9 @@ class Router:
                 request_id=request_id,
                 session=payload.get("session_id"),
             ):
-                status, body = self._route_act_inner(payload, request_id)
+                status, body, served_by = self._route_act_inner(
+                    payload, request_id
+                )
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -502,7 +521,49 @@ class Router:
         else:
             outcome = "failed"
         self.slo.observe(outcome, elapsed)
+        # Attribute the same outcome to the replica that produced it.
+        # `served_by` is None for requests no replica answered (admission
+        # shed, draining, no capacity, failover budget exhausted) — those
+        # stay fleet-wide only.
+        self._observe_replica(served_by, outcome, elapsed)
         return status, body
+
+    def _observe_replica(
+        self, replica_id: Optional[int], outcome: str, elapsed: float
+    ) -> None:
+        """Book one outcome on the serving replica's own ledger (lazily
+        created with the fleet ledger's objectives). None = no replica
+        produced this response; the fleet-wide ledger already has it."""
+        if replica_id is None:
+            return
+        with self._lock:
+            ledger = self._replica_slo.get(replica_id)
+            if ledger is None:
+                ledger = SLOLedger(self.slo.objectives)
+                self._replica_slo[replica_id] = ledger
+        ledger.observe(outcome, elapsed)
+
+    def replica_slo_snapshot(self) -> Dict[int, Dict[str, Any]]:
+        """Per-replica outcome attribution, keyed by replica id: the
+        outcome-class counts plus the rolling availability / burn pair a
+        canary judgement reads. Only replicas that ever answered appear;
+        a removed replica's entry is dropped with it."""
+        with self._lock:
+            ledgers = sorted(self._replica_slo.items())
+        out: Dict[int, Dict[str, Any]] = {}
+        for rid, ledger in ledgers:
+            gauges = ledger.gauges()
+            out[rid] = {
+                "outcomes": {
+                    o: int(gauges[f"slo_requests_{o}"]) for o in OUTCOMES
+                },
+                "requests_total": int(gauges["slo_requests_total"]),
+                "availability_rolling": gauges["slo_availability_rolling"],
+                "error_budget_burn_rolling": gauges[
+                    "slo_error_budget_burn_rolling"
+                ],
+            }
+        return out
 
     def _note_act(self, session_id) -> None:
         """Record an answered act for the occupancy signal (recency
@@ -536,12 +597,20 @@ class Router:
 
     def _route_act_inner(
         self, payload: Dict[str, Any], request_id: str
-    ) -> Tuple[int, Dict[str, Any]]:
+    ) -> Tuple[int, Dict[str, Any], Optional[int]]:
+        """Route one /act -> (status, body, served_by). ``served_by`` is
+        the id of the replica whose answer (or terminal error) this is,
+        None when no replica produced the response — the per-replica SLO
+        attribution key."""
         session_id = payload.get("session_id")
         if not isinstance(session_id, str) or not session_id:
-            return 400, {"error": "'session_id' must be a non-empty string"}
+            return (
+                400,
+                {"error": "'session_id' must be a non-empty string"},
+                None,
+            )
         if self.draining:
-            return 503, {"error": "draining"}
+            return 503, {"error": "draining"}, None
         if self.admission is not None:
             # Admission control BEFORE placement: a shed request must be
             # fast (no replica hop) and cheap (no affinity mutation). The
@@ -554,24 +623,29 @@ class Router:
             )
             if reason is not None:
                 self.metrics.observe_shed(reason)
-                return 429, {
-                    "error": f"admission control shed this request "
-                    f"({reason})",
-                    "reason": reason,
-                    # Explicitly NOT retry:true — the client should back
-                    # off, not hammer the token bucket (contrast the
-                    # transient 503 busy path).
-                    "retry": False,
-                }
+                return (
+                    429,
+                    {
+                        "error": f"admission control shed this request "
+                        f"({reason})",
+                        "reason": reason,
+                        # Explicitly NOT retry:true — the client should
+                        # back off, not hammer the token bucket (contrast
+                        # the transient 503 busy path).
+                        "retry": False,
+                    },
+                    None,
+                )
         fwd_headers = {reqtrace.REQUEST_ID_HEADER: request_id}
         last_error = "no ready replicas"
         for _ in range(self.max_failovers + 1):
             replica = self._replica_for(session_id)
             if replica is None:
-                return 503, {
-                    "error": "no ready replicas",
-                    "retry": True,
-                }
+                return (
+                    503,
+                    {"error": "no ready replicas", "retry": True},
+                    None,
+                )
             # Snapshot the url: the supervisor may respawn this replica
             # (resetting url to None) between our request and the probe.
             target_url = replica.url
@@ -608,11 +682,15 @@ class Router:
                         self._orphaned.discard(session_id)
                         body["restarted"] = True
                         self.metrics.observe_session_restart()
-            return status, body
-        return 503, {
-            "error": f"failover budget exhausted: {last_error}",
-            "retry": True,
-        }
+            return status, body, replica.id
+        return (
+            503,
+            {
+                "error": f"failover budget exhausted: {last_error}",
+                "retry": True,
+            },
+            None,
+        )
 
     def route_session_op(
         self, path: str, payload: Dict[str, Any]
@@ -792,13 +870,19 @@ class Router:
         return {
             **self.metrics_snapshot(),
             "replicas": {str(rid): snap for rid, snap in replicas.items()},
+            "replica_slo": {
+                str(rid): entry
+                for rid, entry in self.replica_slo_snapshot().items()
+            },
         }
 
     def fleet_metrics_prometheus(self) -> str:
         """One exposition body for the whole fleet: router families at
         their usual names + ``rt1_serve_replica_*{replica_id="N"}``."""
         return obs_prometheus.render_fleet_snapshot(
-            self.metrics_snapshot(), self.probe_replica_metrics()
+            self.metrics_snapshot(),
+            self.probe_replica_metrics(),
+            replica_slo=self.replica_slo_snapshot(),
         )
 
     def fleet_slow_requests(self) -> Dict[str, Any]:
@@ -812,9 +896,13 @@ class Router:
         live replica's own /metrics is sampled for the single-compile and
         reload evidence the chaos bench asserts on."""
         replicas = []
+        replica_slo = self.replica_slo_snapshot()
         for replica in sorted(self.replicas(), key=lambda r: r.id):
             entry = replica.summary()
             entry["sessions"] = self.session_count(replica.id)
+            slo = replica_slo.get(replica.id)
+            if slo is not None:
+                entry["slo"] = slo
             if probe_metrics and replica.url and replica.state != DEAD:
                 status, body = get_json(replica.url + "/metrics", timeout=5.0)
                 if status == 200:

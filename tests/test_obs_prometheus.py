@@ -336,10 +336,74 @@ def test_fleet_metric_names_all_renderable():
     # labeled {replica_id, task}.
     full["task_requests_total"] = {"block2block": 5, "unlabeled": 1}
     full["task_sessions_total"] = {"block2block": 2}
-    text = prom.render_fleet_snapshot({}, {0: full})
+    # The per-replica SLO families render from the router-attributed
+    # snapshot (ISSUE 16), not the replica /metrics fan-out.
+    replica_slo = {
+        0: {
+            "outcomes": {"ok": 5, "restarted": 1, "rejected": 0, "failed": 0},
+            "requests_total": 6,
+            "availability_rolling": 5 / 6,
+            "error_budget_burn_rolling": (1 / 6) / 0.01,
+        }
+    }
+    text = prom.render_fleet_snapshot({}, {0: full}, replica_slo=replica_slo)
     types, _ = parse_exposition(text)
     for name in names:
         assert name in types, f"{name} missing from a full snapshot render"
+
+
+def test_replica_slo_families_render_per_replica_attribution():
+    """Per-replica SLO attribution naming contract (ISSUE 16): the
+    router-attributed outcome counters render double-labeled
+    {replica_id, outcome} and the rolling availability/burn pair render
+    per replica_id — distinguishable burn is what the canary judgement
+    reads. Absent replica_slo keeps the exposition byte-identical."""
+    replica_slo = {
+        0: {
+            "outcomes": {"ok": 9, "restarted": 0, "rejected": 0, "failed": 0},
+            "requests_total": 9,
+            "availability_rolling": 1.0,
+            "error_budget_burn_rolling": 0.0,
+        },
+        1: {
+            "outcomes": {"ok": 3, "restarted": 1, "rejected": 0, "failed": 0},
+            "requests_total": 4,
+            "availability_rolling": 0.75,
+            "error_budget_burn_rolling": 25.0,
+        },
+    }
+    text = prom.render_fleet_snapshot({}, {}, replica_slo=replica_slo)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_replica_outcome_total"] == "counter"
+    assert types["rt1_serve_replica_slo_availability_rolling"] == "gauge"
+    assert types["rt1_serve_replica_slo_error_budget_burn_rolling"] == "gauge"
+    assert (
+        "rt1_serve_replica_outcome_total",
+        {"replica_id": "1", "outcome": "restarted"},
+        "1",
+    ) in samples
+    assert (
+        "rt1_serve_replica_outcome_total",
+        {"replica_id": "0", "outcome": "ok"},
+        "9",
+    ) in samples
+    burns = {
+        labels["replica_id"]: float(v)
+        for n, labels, v in samples
+        if n == "rt1_serve_replica_slo_error_budget_burn_rolling"
+    }
+    assert burns == {"0": 0.0, "1": 25.0}
+    # The contract list names all three families.
+    names = prom.fleet_metric_names()
+    for family in (
+        "rt1_serve_replica_outcome_total",
+        "rt1_serve_replica_slo_availability_rolling",
+        "rt1_serve_replica_slo_error_budget_burn_rolling",
+    ):
+        assert family in names
+    # No replica_slo argument -> none of the families appear (old shape).
+    bare = prom.render_fleet_snapshot({}, {})
+    assert "rt1_serve_replica_outcome_total" not in bare
 
 
 def test_inference_dtype_info_family_and_param_bytes_gauges():

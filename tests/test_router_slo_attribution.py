@@ -1,0 +1,145 @@
+"""Per-replica SLO attribution (ISSUE 16 satellite): the router books
+every routed outcome against the replica that answered it, so one
+replica's burn — the canary question — is distinguishable from the
+fleet's. In-process stub replicas + a Router instance, no subprocesses:
+tier-1 fast."""
+
+import pytest
+
+from rt1_tpu.obs import prometheus as prom
+from rt1_tpu.serve.router import READY, Replica, Router
+from rt1_tpu.serve.stub import StubReplicaApp, make_stub_server
+
+
+@pytest.fixture()
+def fleet():
+    apps, servers, threads = [], [], []
+    router = Router(replica_timeout_s=5.0)
+    import threading
+
+    for rid in range(2):
+        app = StubReplicaApp(replica_id=rid)
+        httpd = make_stub_server(app)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        host, port = httpd.server_address[:2]
+        replica = router.add_replica(
+            Replica(rid, url=f"http://{host}:{port}")
+        )
+        replica.state = READY
+        apps.append(app)
+        servers.append(httpd)
+        threads.append(thread)
+    yield router, servers
+    for httpd in servers:
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+def _act(router, session_id):
+    return router.route_act(
+        {"session_id": session_id, "image_b64": "AAAA"}
+    )
+
+
+def test_outcomes_attributed_to_serving_replica(fleet):
+    router, _ = fleet
+    # Least-loaded placement with a lower-id tiebreak: "a" lands on
+    # replica 0, "b" on replica 1 — a deterministic 2-way split.
+    for _ in range(3):
+        status, body = _act(router, "a")
+        assert status == 200 and body["replica_id"] == 0
+    for _ in range(2):
+        status, body = _act(router, "b")
+        assert status == 200 and body["replica_id"] == 1
+
+    snap = router.replica_slo_snapshot()
+    assert set(snap) == {0, 1}
+    assert snap[0]["outcomes"]["ok"] == 3
+    assert snap[1]["outcomes"]["ok"] == 2
+    for entry in snap.values():
+        assert entry["requests_total"] == sum(entry["outcomes"].values())
+        assert entry["availability_rolling"] == 1.0
+        assert entry["error_budget_burn_rolling"] == 0.0
+    # Per-replica counts sum to the fleet ledger's — same outcome stream,
+    # two attributions.
+    fleet_gauges = router.slo.gauges()
+    assert fleet_gauges["slo_requests_ok"] == 5
+
+    # The attribution rides /fleet/status...
+    status_view = router.fleet_status(probe_metrics=False)
+    by_id = {e["id"]: e for e in status_view["replicas"]}
+    assert by_id[0]["slo"]["outcomes"]["ok"] == 3
+    assert by_id[1]["slo"]["outcomes"]["ok"] == 2
+    # ...the JSON fan-out...
+    json_view = router.fleet_metrics_snapshot()
+    assert json_view["replica_slo"]["0"]["outcomes"]["ok"] == 3
+    # ...and the Prometheus exposition.
+    text = router.fleet_metrics_prometheus()
+    assert (
+        'rt1_serve_replica_outcome_total{replica_id="0",outcome="ok"} 3'
+        in text
+    )
+    assert (
+        'rt1_serve_replica_slo_error_budget_burn_rolling{replica_id="1"} 0'
+        in text
+    )
+
+
+def test_sheds_without_a_replica_stay_fleet_wide(fleet):
+    router, _ = fleet
+    status, body = _act(router, "a")
+    assert status == 200
+    router.draining = True
+    status, _ = _act(router, "a")
+    assert status == 503
+    router.draining = False
+    # The shed burned fleet-wide budget but no replica produced it:
+    # blaming one would poison a canary verdict.
+    assert router.slo.gauges()["slo_requests_rejected"] == 1
+    snap = router.replica_slo_snapshot()
+    assert sum(e["outcomes"]["rejected"] for e in snap.values()) == 0
+    assert sum(e["requests_total"] for e in snap.values()) == 1
+
+
+def test_replica_death_attributes_final_outcome_to_survivor(fleet):
+    router, servers = fleet
+    status, body = _act(router, "a")  # -> replica 0
+    assert status == 200 and body["replica_id"] == 0
+    status, body = _act(router, "b")  # -> replica 1
+    assert status == 200 and body["replica_id"] == 1
+    # Kill replica 1's server: session "b"'s next act fails over to
+    # replica 0 and surfaces restarted:true. The final outcome class
+    # (restarted) is attributed to the replica that ANSWERED — the dead
+    # one reports nothing (its absence shows up as replica_up 0).
+    servers[1].shutdown()
+    servers[1].server_close()
+    status, body = _act(router, "b")
+    assert status == 200
+    assert body["restarted"] is True
+    assert body["replica_id"] == 0
+    snap = router.replica_slo_snapshot()
+    assert snap[0]["outcomes"]["restarted"] == 1
+    assert snap[1]["outcomes"] == {
+        "ok": 1, "restarted": 0, "rejected": 0, "failed": 0
+    }
+
+
+def test_remove_replica_drops_its_ledger(fleet):
+    router, _ = fleet
+    _act(router, "a")
+    _act(router, "b")
+    assert set(router.replica_slo_snapshot()) == {0, 1}
+    router.remove_replica(1)
+    # Dropped, not zeroed — same ghost-purge contract as the metrics
+    # fan-out: a reclaimed replica's series vanish from every view.
+    snap = router.replica_slo_snapshot()
+    assert set(snap) == {0}
+    text = router.fleet_metrics_prometheus()
+    assert 'rt1_serve_replica_outcome_total{replica_id="1"' not in text
+    assert 'rt1_serve_replica_outcome_total{replica_id="0"' in text
+    status_view = router.fleet_status(probe_metrics=False)
+    assert [e["id"] for e in status_view["replicas"]] == [0]
