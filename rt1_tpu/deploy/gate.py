@@ -1,0 +1,145 @@
+"""The real (jax-heavy) promotion gate: eval matrix + serve parity.
+
+Composes the two offline judgements the ISSUE names into one verdict
+payload for the controller:
+
+* **eval-matrix gate** (`eval/matrix.run_gate`): closed-loop success of
+  the candidate checkpoint vs. the incumbent on the same task grid,
+  lazy per-column restore — one parameter set resident at a time.
+* **parity gate** (`serve/parity.check_parity`): the candidate restored
+  into a serving engine at the fleet's inference dtype must agree with
+  its own f32 reference on ≥99% of action tokens over the canned
+  episode set — the same bar a quantized replica must clear before it
+  serves (`tests/test_quant.py`). Catches a checkpoint that evals fine
+  but quantizes badly BEFORE it touches a live replica.
+
+Everything heavy imports lazily inside the functions: the module itself
+must stay importable in the blocker-pinned controller process
+(`tests/test_obs_imports.py`); only *calling* the gate pays the jax
+context. The stub fleet path injects an auto-pass `gate_fn` instead and
+never imports this module's internals.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+
+def load_config(path: str):
+    """Execute a train config file (`rt1_tpu/train/configs/*.py`) and
+    return its ``get_config()``. The fleet supervisor is argparse-based
+    (no absl/config_flags in that process); this is the minimal loader
+    so ``--promote_from`` can bind the real gate to the same config file
+    the replicas were launched with."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("rt1_deploy_gate_cfg", path)
+    if spec is None or spec.loader is None:
+        raise ValueError(f"cannot load config file: {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.get_config()
+
+
+def run_parity_gate(
+    config,
+    workdir: str,
+    step: int,
+    *,
+    inference_dtype: str = "f32",
+    threshold: Optional[float] = None,
+    episodes: int = 2,
+    steps: int = 4,
+) -> Dict[str, Any]:
+    """Restore `step` twice — f32 reference + serving dtype — and run the
+    action-token parity check. Returns the stats dict; a failed gate
+    returns ``passed: False`` (the ValueError is caught and folded in)
+    so the controller records a rejection instead of crashing the loop."""
+    from rt1_tpu.eval.restore import build_serve_engine
+    from rt1_tpu.serve import parity
+
+    engine_ref, _ = build_serve_engine(
+        config, workdir=workdir, step=step, inference_dtype="f32"
+    )
+    engine_test, _ = build_serve_engine(
+        config, workdir=workdir, step=step, inference_dtype=inference_dtype
+    )
+    shape = (config.data.height, config.data.width, 3)
+    kwargs: Dict[str, Any] = {"episodes": episodes, "steps": steps}
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    try:
+        stats = parity.check_parity(
+            engine_ref, engine_test, shape, **kwargs
+        )
+    except ValueError as exc:
+        return {
+            "passed": False,
+            "inference_dtype": inference_dtype,
+            "error": str(exc),
+        }
+    stats["inference_dtype"] = inference_dtype
+    return stats
+
+
+def build_gate_fn(
+    config,
+    workdir: str,
+    *,
+    tasks: Optional[Sequence[str]] = None,
+    episodes_per_cell: int = 2,
+    max_episode_steps: int = 80,
+    block_mode: str = "BLOCK_8",
+    seed: int = 0,
+    embedder: str = "hash",
+    env_kwargs: Optional[Dict[str, Any]] = None,
+    margin: float = 0.0,
+    inference_dtype: str = "f32",
+    parity_episodes: int = 2,
+    parity_steps: int = 4,
+    progress: Optional[Callable[[str, str, Dict[str, Any]], None]] = None,
+) -> Callable[[int, Optional[int]], Dict[str, Any]]:
+    """Bind config + gate knobs into the ``gate_fn(candidate_step,
+    incumbent_step) -> verdict`` the PromotionController consumes.
+
+    The verdict passes only when BOTH judgements pass; the eval matrix
+    runs first (cheaper rejection: a regressed policy never pays the
+    double engine build the parity check needs)."""
+    from rt1_tpu.eval import matrix as matrix_lib
+
+    def gate_fn(
+        candidate_step: int, incumbent_step: Optional[int]
+    ) -> Dict[str, Any]:
+        verdict = matrix_lib.run_gate(
+            config,
+            workdir,
+            candidate_step,
+            incumbent_step,
+            tasks=tasks,
+            episodes_per_cell=episodes_per_cell,
+            max_episode_steps=max_episode_steps,
+            block_mode=block_mode,
+            seed=seed,
+            embedder=embedder,
+            env_kwargs=env_kwargs,
+            margin=margin,
+            progress=progress,
+        )
+        eval_passed = bool(verdict["passed"])
+        if eval_passed:
+            parity = run_parity_gate(
+                config,
+                workdir,
+                candidate_step,
+                inference_dtype=inference_dtype,
+                episodes=parity_episodes,
+                steps=parity_steps,
+            )
+            verdict["parity"] = parity
+            verdict["passed"] = bool(parity.get("passed"))
+        else:
+            verdict["parity"] = {"skipped": "eval gate failed"}
+        verdict["eval_passed"] = eval_passed
+        return verdict
+
+    return gate_fn

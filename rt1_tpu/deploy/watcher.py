@@ -1,0 +1,86 @@
+"""Checkpoint discovery on a live train workdir, torn-write tolerant.
+
+The deploy controller watches the trainer's output directory for new
+candidate steps. The scan mirrors `trainer/checkpoints.latest_step`
+EXACTLY — Orbax step dirs are plain integer-named directories, in-flight
+writes are `<step>.orbax-checkpoint-tmp-<ts>` dirs that fail the digit
+check, and an empty integer dir (mkdir landed, contents didn't) is not a
+checkpoint — but lives here as a local replica because importing
+`trainer.checkpoints` drags the full orbax/flax context into the
+supervisor process, which must stay jax-free
+(`tests/test_obs_imports.py`). `tests/test_deploy.py` pins the two
+implementations to identical answers on the same directory.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def latest_checkpoint_step(ckpt_dir: str) -> Optional[int]:
+    """Newest complete checkpoint step under `ckpt_dir`, or None.
+
+    Import-light twin of `rt1_tpu.trainer.checkpoints.latest_step` (same
+    tmp-dir and torn-write tolerance, zero orbax imports)."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = []
+    for d in os.listdir(ckpt_dir):
+        if not d.isdigit():
+            continue  # Orbax tmp dirs and sidecar files
+        full = os.path.join(ckpt_dir, d)
+        try:
+            if not os.path.isdir(full) or not os.listdir(full):
+                continue
+        except OSError:
+            continue
+        steps.append(int(d))
+    return max(steps) if steps else None
+
+
+class CheckpointWatcher:
+    """Poll a train workdir for steps newer than any already seen.
+
+    ``poll()`` returns a NEW candidate step exactly once (then remembers
+    it), so the controller's tick loop can call it unconditionally. Steps
+    at or below the high-water mark — including the incumbent the fleet
+    booted from, and candidates already gated-and-rejected — are never
+    re-surfaced; `dismiss(step)` raises the mark explicitly when a
+    candidate is disposed of out of band."""
+
+    def __init__(self, workdir: str, *, seen_through: Optional[int] = None):
+        self.workdir = workdir
+        self.ckpt_dir = os.path.join(workdir, "checkpoints")
+        # High-water mark: poll() only surfaces steps strictly above it.
+        self.seen_through = -1 if seen_through is None else seen_through
+        self.polls = 0
+
+    def poll(self) -> Optional[int]:
+        self.polls += 1
+        step = latest_checkpoint_step(self.ckpt_dir)
+        if step is None or step <= self.seen_through:
+            return None
+        self.seen_through = step
+        return step
+
+    def pending_steps(self) -> List[int]:
+        """Every complete step currently on disk (ascending) — the
+        run-report provenance view, not the dedup path."""
+        if not os.path.isdir(self.ckpt_dir):
+            return []
+        steps = []
+        for d in os.listdir(self.ckpt_dir):
+            if not d.isdigit():
+                continue
+            full = os.path.join(self.ckpt_dir, d)
+            try:
+                if not os.path.isdir(full) or not os.listdir(full):
+                    continue
+            except OSError:
+                continue
+            steps.append(int(d))
+        return sorted(steps)
+
+    def dismiss(self, step: int) -> None:
+        self.seen_through = max(self.seen_through, step)

@@ -321,6 +321,102 @@ def run_matrix(
     return state
 
 
+def run_gate(
+    config,
+    workdir: str,
+    candidate_step: int,
+    incumbent_step: Optional[int] = None,
+    *,
+    tasks: Optional[Sequence[str]] = None,
+    episodes_per_cell: int = 2,
+    max_episode_steps: int = 80,
+    block_mode: str = "BLOCK_8",
+    seed: int = 0,
+    embedder: str = "hash",
+    env_kwargs: Optional[Dict[str, Any]] = None,
+    margin: float = 0.0,
+    state: Optional[EvalMatrixState] = None,
+    progress: Optional[Callable[[str, str, Dict[str, Any]], None]] = None,
+) -> Dict[str, Any]:
+    """The offline promotion gate as ONE library call: candidate vs.
+    incumbent on the same task grid -> a verdict dict.
+
+    Library entry for the deploy controller (the CLI keeps its own sweep
+    loop): runs `run_matrix` over the two checkpoint columns with the
+    same lazy policy factories the CLI builds — the incumbent column is
+    restored, swept, and released before the candidate restores, so the
+    caller never holds two parameter sets in memory.
+
+    Pass criterion: candidate mean per-cell success must reach the
+    incumbent's minus ``margin`` (>= incumbent - margin). With no
+    incumbent (first deploy into an empty fleet) the candidate gates
+    against 0.0 — any evaluable checkpoint passes, which is the honest
+    floor when there is nothing to regress against. The verdict carries
+    the full matrix so the signed artifact IS the evidence.
+    """
+    t0 = time.time()
+    tasks = tuple(tasks) if tasks else default_task_names()
+    columns: List[Tuple[str, Any]] = []
+    if incumbent_step is not None:
+        columns.append(
+            (
+                str(incumbent_step),
+                lambda s=incumbent_step: policy_for_checkpoint(
+                    config, workdir, s
+                )[0],
+            )
+        )
+    columns.append(
+        (
+            str(candidate_step),
+            lambda s=candidate_step: policy_for_checkpoint(
+                config, workdir, s
+            )[0],
+        )
+    )
+    state = run_matrix(
+        columns,
+        tasks,
+        episodes_per_cell=episodes_per_cell,
+        max_episode_steps=max_episode_steps,
+        block_mode=block_mode,
+        seed=seed,
+        embedder=embedder,
+        env_kwargs=env_kwargs,
+        state=state,
+        progress=progress,
+    )
+    matrix = state.matrix()
+
+    def _mean(label: str) -> float:
+        rates = [
+            row[label]["success_rate"]
+            for row in matrix.values()
+            if label in row and row[label]["episodes"]
+        ]
+        return sum(rates) / len(rates) if rates else 0.0
+
+    candidate_mean = _mean(str(candidate_step))
+    incumbent_mean = (
+        _mean(str(incumbent_step)) if incumbent_step is not None else 0.0
+    )
+    return {
+        "gate": "eval_matrix",
+        "candidate_step": int(candidate_step),
+        "incumbent_step": (
+            int(incumbent_step) if incumbent_step is not None else None
+        ),
+        "tasks": sorted(matrix),
+        "episodes_per_cell": episodes_per_cell,
+        "candidate_mean_success": round(candidate_mean, 4),
+        "incumbent_mean_success": round(incumbent_mean, 4),
+        "margin": margin,
+        "passed": candidate_mean >= incumbent_mean - margin,
+        "matrix": matrix,
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+
+
 def matrix_record(
     state: EvalMatrixState,
     *,

@@ -37,6 +37,14 @@ Spec grammar (comma-separated)::
                          AFTER the shard files land but BEFORE the
                          manifest rename — the torn-append window readers
                          must be immune to (rt1_tpu/data/pack.py)
+    promote@1            deploy: raise OSError on the 1st fleet-wide
+                         promote the PromotionController attempts — the
+                         controller must roll the canary back and leave
+                         the incumbent serving (rt1_tpu/deploy/)
+    canary_slo_breach@3  deploy: force the canary burn signal over the
+                         rollback threshold starting at canary-watch
+                         tick 3 (synthetic breach: client traffic stays
+                         clean, the decision path is what's under test)
     <site>@<n>x<k>       fire on k consecutive occurrences starting at n
                          (e.g. nan_batch@3x4 poisons batches 3,4,5,6)
 
@@ -88,6 +96,8 @@ KNOWN_SITES = (
     "serve_reload",
     "capture_write",
     "pack_append",
+    "promote",
+    "canary_slo_breach",
 )
 
 

@@ -1059,6 +1059,53 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--slo_p99_ms", type=float, default=2500.0,
         help="Router SLO: answered-request p99 objective (ms).")
+    parser.add_argument(
+        "--promote_from", default="",
+        help="Continuous deployment (rt1_tpu/deploy): watch this train "
+             "workdir for new checkpoints, gate them offline, canary "
+             "onto one replica at --canary_weight, promote fleet-wide "
+             "after a clean burn window, auto-rollback on breach. Stub "
+             "fleets auto-pass the offline gate (the supervisor process "
+             "stays jax-free); real fleets run the eval-matrix + parity "
+             "gate against --config.")
+    parser.add_argument(
+        "--canary_weight", type=float, default=0.25,
+        help="Fraction of FRESH sessions routed to the canary replica "
+             "(existing sessions keep their affinity).")
+    parser.add_argument(
+        "--burn_threshold", type=float, default=2.0,
+        help="Canary rolling error-budget burn rate that counts as a "
+             "breach (must also strictly exceed the incumbent fleet's).")
+    parser.add_argument(
+        "--breach_ticks", type=int, default=2,
+        help="Consecutive breach ticks before auto-rollback.")
+    parser.add_argument(
+        "--clean_window_ticks", type=int, default=5,
+        help="Consecutive clean ticks before fleet-wide promotion.")
+    parser.add_argument(
+        "--min_canary_requests", type=int, default=8,
+        help="Evidence floor: hold the canary verdict until it has "
+             "served this many requests (breaches still fire).")
+    parser.add_argument(
+        "--deploy_poll_interval_s", type=float, default=1.0,
+        help="Promotion-controller tick interval.")
+    parser.add_argument(
+        "--gate_episodes", type=int, default=2,
+        help="Eval-matrix episodes per task cell in the promotion gate "
+             "(real fleets only).")
+    parser.add_argument(
+        "--gate_tasks", default="",
+        help="Comma list of reward-family tasks for the promotion gate "
+             "(empty = every canonical family).")
+    parser.add_argument(
+        "--gate_max_steps", type=int, default=80,
+        help="Max env steps per gate eval episode.")
+    parser.add_argument(
+        "--deploy_incumbent_step", type=int, default=-1,
+        help="Checkpoint step the fleet is currently serving (the gate "
+             "baseline and rollback target). -1 = auto: the newest step "
+             "in --promote_from at arm time; only checkpoints appearing "
+             "AFTER that are candidates.")
     parser.add_argument("--faults", default="",
                         help="Chaos plan, e.g. 'replica_kill@1,"
                              "serve_reload@2' (RT1_FAULTS appended).")
@@ -1145,6 +1192,66 @@ def main(argv=None) -> int:
         reclaim_grace_s=args.reclaim_grace_s,
     )
     supervisor.start(wait_ready=True)
+
+    controller = None
+    if args.promote_from:
+        from rt1_tpu.deploy.controller import PromotionController
+        from rt1_tpu.deploy.decision import CanaryPolicy
+        from rt1_tpu.deploy.watcher import latest_checkpoint_step
+
+        if args.deploy_incumbent_step >= 0:
+            incumbent = args.deploy_incumbent_step
+        else:
+            # Auto: whatever is newest at arm time is what the fleet is
+            # (presumed) serving — only LATER checkpoints are candidates.
+            incumbent = latest_checkpoint_step(
+                os.path.join(args.promote_from, "checkpoints")
+            )
+        if args.stub:
+            # The supervisor process stays jax-free with stub replicas:
+            # the offline gate auto-passes (canary burn + rollback paths
+            # are what a stub deploy cycle exercises).
+            def gate_fn(candidate_step, incumbent_step):
+                return {
+                    "gate": "auto_pass_stub",
+                    "passed": True,
+                    "candidate_step": candidate_step,
+                    "incumbent_step": incumbent_step,
+                }
+        else:
+            from rt1_tpu.deploy.gate import build_gate_fn, load_config
+
+            gate_tasks = [t for t in args.gate_tasks.split(",") if t]
+            gate_fn = build_gate_fn(
+                load_config(args.config),
+                args.promote_from,
+                tasks=gate_tasks or None,
+                episodes_per_cell=args.gate_episodes,
+                max_episode_steps=args.gate_max_steps,
+                inference_dtype=args.inference_dtype,
+            )
+        try:
+            canary_policy = CanaryPolicy(
+                burn_threshold=args.burn_threshold,
+                breach_ticks=args.breach_ticks,
+                clean_window_ticks=args.clean_window_ticks,
+                min_canary_requests=args.min_canary_requests,
+                canary_weight=args.canary_weight,
+            )
+        except ValueError as exc:
+            parser.error(str(exc))
+        controller = PromotionController(
+            router,
+            args.promote_from,
+            gate_fn=gate_fn,
+            policy=canary_policy,
+            incumbent_step=incumbent,
+            poll_interval_s=args.deploy_poll_interval_s,
+        )
+        router.deploy_gauges_fn = controller.deploy_gauges
+        router.deploy_status_fn = controller.summary
+        controller.start()
+
     httpd = make_router_server(
         router, host=args.host, port=args.port, quiet=not args.verbose
     )
@@ -1179,6 +1286,15 @@ def main(argv=None) -> int:
                     else None
                 ),
                 "admission": admission is not None,
+                "deploy": (
+                    {
+                        "promote_from": args.promote_from,
+                        "incumbent_step": controller.incumbent_step,
+                        "canary_weight": args.canary_weight,
+                    }
+                    if controller is not None
+                    else None
+                ),
                 "faults": args.faults or os.environ.get(faults.ENV_VAR, ""),
             }
         ),
@@ -1188,6 +1304,10 @@ def main(argv=None) -> int:
         httpd.serve_forever()
     finally:
         httpd.server_close()
+        if controller is not None:
+            # Stop deciding BEFORE the drain flips: a promote/rollback
+            # racing the shutdown would reload replicas mid-teardown.
+            controller.stop()
         router.draining = True
         final = {
             "status": "stopped",
@@ -1203,6 +1323,12 @@ def main(argv=None) -> int:
             # into its BENCH record without re-deriving it client-side.
             "slo": router.slo.summary(),
             "slow_requests": supervisor.slow_request_evidence(),
+            # Promotion evidence (None without --promote_from): the full
+            # gate/canary/promote/rollback timeline the deploy bench and
+            # run-report consume.
+            "deploy": (
+                controller.summary() if controller is not None else None
+            ),
         }
         supervisor.stop()
         # Replicas drained on SIGTERM (writing their in-flight capture

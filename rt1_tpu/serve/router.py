@@ -80,12 +80,13 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import threading
 import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
@@ -341,6 +342,21 @@ class Router:
         # analogue): an autoscale signal and the global-shed input.
         self._inflight = 0
         self.draining = False
+        # Weighted canary placement (deploy subsystem): while set, a
+        # configured fraction of FRESH session placements land on the
+        # canary replica instead of the least-loaded pick. Existing
+        # sessions keep their affinity — a canary experiments on new
+        # traffic, it never steals live windows.
+        self._canary_id: Optional[int] = None
+        self._canary_weight = 0.0
+        self._fresh_placements = 0  # Bresenham counter, reset per canary
+        # Deployment seam (ISSUE 16): fleet main points these at the
+        # PromotionController when --promote_from is armed. The router
+        # itself stays deploy-agnostic — when unset, /metrics and the
+        # status surface are byte-identical to a fleet without a
+        # controller.
+        self.deploy_gauges_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        self.deploy_status_fn: Optional[Callable[[], Dict[str, Any]]] = None
 
     # ------------------------------------------------------------ registry
 
@@ -429,23 +445,129 @@ class Router:
         loads = {rid: 0 for rid in self._replicas}
         for rid in self._sessions.values():
             loads[rid] = loads.get(rid, 0) + 1
-        # Tier-aware least-loaded: load first (surge capacity absorbs
-        # genuine overflow), then the pinned base tier on ties (the
-        # full-precision parity canary keeps serving the steady state).
-        best = min(
-            ready,
-            key=lambda r: (
-                loads.get(r.id, 0),
-                _TIER_RANK.get(r.tier, 0),
-                r.id,
-            ),
+
+        def least_loaded(candidates):
+            # Tier-aware least-loaded: load first (surge capacity absorbs
+            # genuine overflow), then the pinned base tier on ties (the
+            # full-precision parity canary keeps serving the steady
+            # state).
+            return min(
+                candidates,
+                key=lambda r: (
+                    loads.get(r.id, 0),
+                    _TIER_RANK.get(r.tier, 0),
+                    r.id,
+                ),
+            )
+
+        best = None
+        canary = (
+            self._replicas.get(self._canary_id)
+            if self._canary_id is not None
+            else None
         )
+        if canary is not None and canary.state == READY:
+            # Deterministic weighted split (Bresenham): the n-th fresh
+            # placement goes to the canary iff the running floor of
+            # n*weight ticks up — exactly weight of fresh sessions, no
+            # RNG, replayable in tests. A not-READY canary (mid-reload)
+            # simply drops out of the split until it recovers.
+            n = self._fresh_placements
+            self._fresh_placements = n + 1
+            w = self._canary_weight
+            if math.floor((n + 1) * w) > math.floor(n * w):
+                best = canary
+            else:
+                rest = [r for r in ready if r.id != canary.id]
+                if rest:
+                    best = least_loaded(rest)
+                # A fleet where the canary is the only ready replica
+                # falls through: serving beats the split.
+        if best is None:
+            best = least_loaded(ready)
         self._sessions[session_id] = best.id
         self._sessions.move_to_end(session_id)
         while len(self._sessions) > self.max_tracked_sessions:
             stale, _ = self._sessions.popitem(last=False)
             self._orphaned.discard(stale)
         return best
+
+    # -------------------------------------------------------------- canary
+
+    def set_canary(self, replica_id: int, weight: float) -> None:
+        """Start the weighted canary split: `weight` of FRESH session
+        placements land on `replica_id` (its existing sessions and every
+        other session's affinity are untouched). The Bresenham counter
+        resets so each canary's split starts deterministically."""
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"canary weight must be in (0, 1], got {weight}")
+        with self._lock:
+            if replica_id not in self._replicas:
+                raise KeyError(f"unknown replica {replica_id}")
+            self._canary_id = replica_id
+            self._canary_weight = float(weight)
+            self._fresh_placements = 0
+
+    def clear_canary(self) -> Optional[int]:
+        """End the split, keeping the canary's sessions where they are —
+        the PROMOTE path (the canary's checkpoint just became the fleet's,
+        so its sessions are already on the right params)."""
+        with self._lock:
+            rid = self._canary_id
+            self._canary_id = None
+            self._canary_weight = 0.0
+            self._fresh_placements = 0
+            return rid
+
+    def demote_canary(self) -> Optional[int]:
+        """End the split AND evict the canary's sessions — the ROLLBACK
+        path: every session on the breaching candidate re-homes through
+        the existing failover machinery (next act lands on an incumbent
+        replica with ``restarted: true``, never a 5xx)."""
+        with self._lock:
+            rid = self._canary_id
+            self._canary_id = None
+            self._canary_weight = 0.0
+            self._fresh_placements = 0
+            if rid is not None:
+                self._orphan_sessions_locked(rid)
+            return rid
+
+    def canary_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica_id": self._canary_id,
+                "weight": self._canary_weight,
+                "fresh_placements": self._fresh_placements,
+            }
+
+    def reload_one(
+        self, replica_id: int, step: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Hot-swap ONE replica — the canary-load / canary-rollback
+        primitive. Same entry shape as a `rolling_reload` element: POST
+        `/reload`, then wait for `/readyz` to recover (``recovered``), so
+        the caller knows the replica is serving the requested step before
+        any traffic decision leans on it."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+        if replica is None:
+            return {"replica": replica_id, "skipped": "unknown"}
+        if replica.state == DEAD or replica.url is None:
+            return {"replica": replica_id, "skipped": replica.state}
+        payload = {} if step is None else {"step": step}
+        status, body = post_json(
+            replica.url + "/reload", payload, self.reload_timeout_s
+        )
+        entry = {"replica": replica_id, "status": status, **body}
+        if status == 0:
+            self.mark_dead(replica, reason=body.get("error", ""))
+        elif status == 200:
+            entry["recovered"] = self._await_ready(replica)
+            if not entry["recovered"]:
+                entry["ok"] = False
+            self.metrics.observe_reload()
+        return entry
 
     def _replica_for(self, session_id: str) -> Optional[Replica]:
         """Existing assignment if its replica is still routable, else a
@@ -810,6 +932,12 @@ class Router:
                 "draining": int(self.draining),
                 "ready": int(states.get(READY, 0) > 0),
                 "router_inflight": self._inflight,
+                # Canary split state (-1 = no canary): dashboards correlate
+                # a replica's burn series with the window it was canary.
+                "canary_replica_id": (
+                    -1 if self._canary_id is None else self._canary_id
+                ),
+                "canary_weight": self._canary_weight,
             }
         if self.admission is not None:
             out.update(self.admission.gauges())
@@ -867,7 +995,7 @@ class Router:
         """The aggregated JSON view: the router's own snapshot (incl. SLO
         gauges) plus every replica's full snapshot under ``replicas``."""
         replicas = self.probe_replica_metrics()
-        return {
+        out = {
             **self.metrics_snapshot(),
             "replicas": {str(rid): snap for rid, snap in replicas.items()},
             "replica_slo": {
@@ -875,15 +1003,25 @@ class Router:
                 for rid, entry in self.replica_slo_snapshot().items()
             },
         }
+        if self.deploy_gauges_fn is not None:
+            out["deploy"] = self.deploy_gauges_fn()
+        return out
 
     def fleet_metrics_prometheus(self) -> str:
         """One exposition body for the whole fleet: router families at
-        their usual names + ``rt1_serve_replica_*{replica_id="N"}``."""
-        return obs_prometheus.render_fleet_snapshot(
+        their usual names + ``rt1_serve_replica_*{replica_id="N"}`` —
+        plus the ``rt1_deploy_*`` families when a promotion controller
+        is attached (one scrape target tells the whole rollout story)."""
+        text = obs_prometheus.render_fleet_snapshot(
             self.metrics_snapshot(),
             self.probe_replica_metrics(),
             replica_slo=self.replica_slo_snapshot(),
         )
+        if self.deploy_gauges_fn is not None:
+            text += obs_prometheus.render_deploy_snapshot(
+                self.deploy_gauges_fn()
+            )
+        return text
 
     def fleet_slow_requests(self) -> Dict[str, Any]:
         """Fan out `/slow_requests`: every live replica's exemplar ring,
@@ -988,6 +1126,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(200, self.router.fleet_slow_requests())
         elif self.path == "/slo":
             self._reply(200, self.router.slo.summary())
+        elif self.path == "/deploy/status":
+            if self.router.deploy_status_fn is None:
+                self._reply(404, {"error": "no promotion controller armed"})
+            else:
+                self._reply(200, self.router.deploy_status_fn())
         elif self.path == "/metrics":
             # ONE scrape target for the whole fleet: the router's own
             # families plus every replica's curated fields, fanned out on

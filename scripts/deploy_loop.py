@@ -1,0 +1,557 @@
+#!/usr/bin/env python
+"""Continuous-deployment driver: train -> gate -> canary -> promote /
+rollback against a REAL two-replica fleet. Writes BENCH_deploy.json.
+
+The end-to-end proof for ISSUE 16 (deploy subsystem), on CPU with the
+tiny config, as two fleet episodes over ONE train workdir:
+
+1. **Good candidate promoted.** Train to the first checkpoint, boot a
+   real fleet with `--promote_from <train_wd>` (replicas restore the
+   incumbent; the controller auto-detects its step), then resume the
+   train job to the next checkpoint WHILE the fleet serves traffic. The
+   controller discovers the candidate, runs the real offline gate
+   (eval-matrix cells vs. the incumbent + the serve parity check),
+   signs the verdict, canaries the candidate onto one replica behind
+   the weighted fresh-session split, and — after a clean burn window —
+   promotes it fleet-wide through the rolling reload. Sessions stick:
+   zero `restarted` flags, zero failed requests, compile_count pinned
+   at bucket_count on every replica.
+2. **Bad candidate rolled back.** Same fleet, rebooted with
+   `canary_slo_breach@N` armed: the next trained checkpoint passes the
+   offline gate (the injected failure is a RUNTIME burn, which is the
+   point — offline eval cannot see it), canaries, breaches its
+   per-replica SLO burn for `breach_ticks` consecutive windows, and is
+   auto-rolled-back: canary demoted, incumbent checkpoint restored
+   onto the replica, canary-bound sessions re-homed through failover
+   with `restarted: true` on their next act. The incumbent step never
+   moves and no request fails.
+
+Run:
+    JAX_PLATFORMS=cpu python scripts/deploy_loop.py \
+        --workdir /tmp/rt1_deploy --bench_out BENCH_deploy.json
+"""
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/deploy_loop.py`
+    sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+TINY_CONFIG = os.path.join(_REPO, "rt1_tpu/train/configs/tiny.py")
+SRC_H, SRC_W = 32, 56  # tiny config data.height/width
+
+
+def _post(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get_json(url, timeout=20.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get_text(url, timeout=20.0):
+    req = urllib.request.Request(url, headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _read_ready_line(proc, timeout_s=900.0):
+    """Parse the fleet's `{"status": "serving", ...}` line (real replicas
+    AOT-compile before it prints — allow minutes on one CPU core)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet exited rc={proc.returncode} before ready"
+                )
+            time.sleep(0.1)
+            continue
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if msg.get("status") == "serving":
+            return msg
+    raise TimeoutError("no fleet ready line within the timeout")
+
+
+def _build_corpus(data_dir, episodes, steps, seed=0):
+    from rt1_tpu.data.episodes import (
+        encode_instruction_text,
+        generate_synthetic_episode,
+        save_episode,
+    )
+
+    train = os.path.join(data_dir, "train")
+    os.makedirs(train, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i in range(episodes):
+        ep = generate_synthetic_episode(
+            rng, num_steps=steps, height=SRC_H, width=SRC_W
+        )
+        ep["task"] = encode_instruction_text("deploy_corpus")
+        path = os.path.join(train, f"episode_{i}.npz")
+        save_episode(path, ep)
+        paths.append(path)
+    return paths
+
+
+def _train_to(train_wd, data_dir, num_steps, log_path):
+    """Run (or resume) the tiny train job to `num_steps` total steps —
+    restore-or-initialize makes the second and third calls pure resumes
+    that add exactly the next checkpoint."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    with open(log_path, "a") as log:
+        rc = subprocess.call(
+            [
+                sys.executable, "-m", "rt1_tpu.train.train",
+                "--config", TINY_CONFIG,
+                "--workdir", train_wd,
+                f"--config.data.data_dir={data_dir}",
+                "--config.data.packed_cache=True",
+                f"--config.num_steps={num_steps}",
+                "--config.checkpoint_every_steps=2",
+                "--config.log_every_steps=1",
+                "--config.eval_every_steps=0",
+            ],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            env=env,
+            cwd=_REPO,
+        )
+    if rc != 0:
+        raise RuntimeError(
+            f"train to {num_steps} failed rc={rc} (see {log_path})"
+        )
+
+
+class Traffic(threading.Thread):
+    """Continuous fleet client: a rolling pool of pinned sessions acted
+    round-robin, plus a fresh session every `fresh_every_s` so the
+    weighted canary split always has placements to work with. Every
+    response is recorded; `restarted: true` flags are the re-homing
+    evidence the bench asserts on."""
+
+    def __init__(self, url, seed=0, fresh_every_s=1.0, pool=6):
+        super().__init__(daemon=True)
+        self.url = url
+        self.fresh_every_s = fresh_every_s
+        self.pool = pool
+        self.stop_evt = threading.Event()
+        self.sessions = []       # every session id ever created (ordered)
+        self.ok = 0
+        self.failures = []       # [{session, error}]
+        self.restarts = []       # [{session, unix_time}]
+        rng = np.random.default_rng(seed)
+        self._frame = rng.integers(
+            0, 256, (SRC_H, SRC_W, 3), dtype=np.uint8
+        )
+        self._embedding = [
+            float(x) for x in rng.standard_normal(512).astype(np.float32)
+        ]
+        self._counter = 0
+
+    def act(self, sid):
+        """One /act; returns the body or None (failure recorded)."""
+        try:
+            body = _post(
+                self.url + "/act",
+                {
+                    "session_id": sid,
+                    "image_b64": base64.b64encode(
+                        self._frame.tobytes()
+                    ).decode("ascii"),
+                    "embedding": self._embedding,
+                    "task": "deploy_probe",
+                },
+                timeout=120.0,
+            )
+        except (urllib.error.URLError, OSError, socket.timeout) as exc:
+            self.failures.append({"session": sid, "error": str(exc)})
+            return None
+        if "action" not in body:
+            self.failures.append({"session": sid, "error": str(body)})
+            return None
+        self.ok += 1
+        if body.get("restarted"):
+            self.restarts.append(
+                {"session": sid, "unix_time": round(time.time(), 3)}
+            )
+        return body
+
+    def _fresh(self):
+        sid = f"probe-{self._counter}"
+        self._counter += 1
+        self.sessions.append(sid)
+        self.act(sid)
+
+    def run(self):
+        last_fresh = 0.0
+        while not self.stop_evt.is_set():
+            now = time.monotonic()
+            if now - last_fresh >= self.fresh_every_s:
+                self._fresh()
+                last_fresh = now
+            for sid in self.sessions[-self.pool:]:
+                if self.stop_evt.is_set():
+                    return
+                self.act(sid)
+            self.stop_evt.wait(0.2)
+
+    def sweep(self, tail=12):
+        """Act the newest `tail` sessions once (caller-thread, after the
+        loop stopped): consumes any pending `restarted` flags so a
+        rollback's re-homing is observed even if it landed between loop
+        passes. Returns the restarted session ids."""
+        restarted = []
+        for sid in self.sessions[-tail:]:
+            body = self.act(sid)
+            if body is not None and body.get("restarted"):
+                restarted.append(sid)
+        return restarted
+
+
+def _deploy_status(url):
+    try:
+        return _get_json(url + "/deploy/status", timeout=15.0)
+    except (urllib.error.URLError, OSError, socket.timeout):
+        return None
+
+
+_TERMINAL = ("promoted", "rolled_back", "gate_rejected",
+             "canary_load_failed", "error")
+
+
+def _wait_terminal(url, timeout_s):
+    """Poll /deploy/status until a terminal timeline event lands; returns
+    (event_entry, full_status). Scrapes stay live through the gate (the
+    controller runs it unlocked), but be tolerant of slow responses on
+    the single busy core."""
+    deadline = time.monotonic() + timeout_s
+    status = None
+    while time.monotonic() < deadline:
+        status = _deploy_status(url)
+        if status is not None:
+            for entry in status.get("timeline", []):
+                if entry.get("event") in _TERMINAL:
+                    return entry, status
+        time.sleep(3.0)
+    raise TimeoutError(
+        "no terminal deploy event within "
+        f"{timeout_s}s (last: {json.dumps(status)[:2000] if status else None})"
+    )
+
+
+def _verify_verdict(train_wd, path):
+    from rt1_tpu.deploy import verdict as verdict_lib
+
+    key = verdict_lib.signing_key(os.path.join(train_wd, "deploy"))
+    payload, ok = verdict_lib.verify_verdict(path, key)
+    return {
+        "path": os.path.relpath(path, train_wd),
+        "signature_ok": bool(ok),
+        "passed": bool(payload.get("passed")) if payload else None,
+        "candidate_step": payload.get("candidate_step") if payload else None,
+        "incumbent_step": payload.get("incumbent_step") if payload else None,
+    }
+
+
+def _fleet_episode(tag, args, train_wd, log_dir, *, faults,
+                   clean_window_ticks, next_train_steps, wait_s):
+    """Boot the fleet, drive traffic, resume training to the candidate
+    checkpoint, wait for the controller's terminal event, collect all
+    the evidence, SIGTERM. Returns the episode record."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    stderr = open(os.path.join(log_dir, f"fleet_{tag}.log"), "w")
+    argv = [
+        sys.executable, "-m", "rt1_tpu.serve.fleet",
+        "--replicas", "2",
+        "--port", "0",
+        "--config", TINY_CONFIG,
+        "--workdir", train_wd,
+        "--promote_from", train_wd,
+        "--max_sessions", "8",
+        "--deploy_poll_interval_s", "1.0",
+        "--canary_weight", "0.5",
+        "--breach_ticks", "2",
+        "--clean_window_ticks", str(clean_window_ticks),
+        "--min_canary_requests", "4",
+        "--gate_episodes", str(args.gate_episodes),
+        "--gate_tasks", args.gate_tasks,
+        "--gate_max_steps", str(args.gate_max_steps),
+    ]
+    if faults:
+        argv += ["--faults", faults]
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=stderr,
+        text=True,
+        env=env,
+        cwd=_REPO,
+    )
+    record = {"episode": tag, "faults": faults or None}
+    traffic = None
+    try:
+        ready = _read_ready_line(proc)
+        assert ready.get("deploy"), f"fleet armed no controller: {ready}"
+        record["ready"] = {
+            "port": ready["port"],
+            "deploy": ready["deploy"],
+        }
+        url = f"http://127.0.0.1:{ready['port']}"
+        print(json.dumps({"phase": f"{tag}_fleet_up",
+                          **ready["deploy"]}), flush=True)
+
+        traffic = Traffic(url, seed=hash(tag) % 2**32)
+        traffic.start()
+
+        # Resume the train job to the candidate checkpoint WHILE the
+        # fleet serves: the controller's watcher must pick the new step
+        # up from a live Orbax save.
+        t0 = time.perf_counter()
+        _train_to(train_wd, args.data_dir, next_train_steps,
+                  os.path.join(log_dir, "train.log"))
+        record["train_resume_seconds"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps({"phase": f"{tag}_candidate_trained",
+                          "num_steps": next_train_steps}), flush=True)
+
+        terminal, status = _wait_terminal(url, wait_s)
+        record["terminal_event"] = terminal
+        record["timeline"] = status["timeline"]
+        record["watch_log_tail"] = status["watch_log"][-12:]
+        print(json.dumps({"phase": f"{tag}_terminal", **terminal}),
+              flush=True)
+
+        # Give the fleet a couple more seconds of live traffic, then
+        # stop the loop and sweep the newest sessions from this thread:
+        # any canary-bound session re-homed by a rollback must surface
+        # `restarted: true` on its next act.
+        time.sleep(2.0)
+        traffic.stop_evt.set()
+        traffic.join(timeout=120)
+        record["post_sweep_restarted"] = traffic.sweep()
+
+        # The verdict artifact must verify against the signing key.
+        verdicts = [
+            _verify_verdict(train_wd, p) for p in status.get("verdicts", [])
+        ]
+        record["verdicts"] = verdicts
+
+        # Compile-count invariant on every replica, through whatever the
+        # episode did (canary load, rolling promote, rollback restore).
+        fstat = _get_json(url + "/fleet/status", timeout=60.0)
+        record["replicas"] = [
+            {
+                "id": r["id"],
+                "state": r["state"],
+                "compile_count": r.get("metrics", {}).get("compile_count"),
+                "bucket_count": r.get("metrics", {}).get("bucket_count"),
+                "reloads_total": r.get("metrics", {}).get("reloads_total"),
+            }
+            for r in fstat["replicas"]
+        ]
+
+        # The rt1_deploy_* families must render on the fleet text scrape.
+        scrape = _get_text(url + "/metrics", timeout=60.0)
+        record["deploy_scrape_lines"] = sorted(
+            line for line in scrape.splitlines()
+            if line.startswith("rt1_deploy_")
+        )[:24]
+    finally:
+        if traffic is not None:
+            traffic.stop_evt.set()
+            traffic.join(timeout=120)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate(timeout=30)
+        stderr.close()
+    final = None
+    for line in (out or "").splitlines():
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if msg.get("status") == "stopped":
+            final = msg
+    assert final is not None, "fleet printed no final record"
+    record["fleet_exit_code"] = proc.returncode
+    record["final_deploy"] = final["deploy"]
+    record["final_slo"] = final["slo"]
+    record["traffic"] = {
+        "requests_ok": traffic.ok,
+        "failures": traffic.failures,
+        "restarts": traffic.restarts,
+        "sessions_created": len(traffic.sessions),
+    }
+    return record
+
+
+def _events(record):
+    return [e["event"] for e in record["timeline"]]
+
+
+def _assert_common(record):
+    assert record["fleet_exit_code"] == 0, record["fleet_exit_code"]
+    assert not record["traffic"]["failures"], record["traffic"]["failures"]
+    assert record["traffic"]["requests_ok"] > 0
+    by_class = record["final_slo"]["by_class"]
+    assert by_class.get("failed", {}).get("count", 0) == 0, by_class
+    for rep in record["replicas"]:
+        assert rep["state"] == "ready", rep
+        assert rep["compile_count"] == rep["bucket_count"], rep
+    for v in record["verdicts"]:
+        assert v["signature_ok"], v
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", default="/tmp/rt1_deploy")
+    p.add_argument("--bench_out", default=os.path.join(
+        _REPO, "BENCH_deploy.json"))
+    p.add_argument("--episodes", type=int, default=8,
+                   help="Synthetic corpus episodes.")
+    p.add_argument("--episode_steps", type=int, default=8)
+    p.add_argument("--gate_tasks", default="block2block",
+                   help="Gate eval-matrix task list (comma separated).")
+    p.add_argument("--gate_episodes", type=int, default=1)
+    p.add_argument("--gate_max_steps", type=int, default=6)
+    p.add_argument("--wait_s", type=float, default=1800.0,
+                   help="Per-episode budget for gate+canary+verdict.")
+    args = p.parse_args()
+
+    from rt1_tpu.data import pack as pack_lib
+
+    t_start = time.perf_counter()
+    wd = os.path.abspath(args.workdir)
+    shutil.rmtree(wd, ignore_errors=True)
+    data_dir = os.path.join(wd, "data")
+    log_dir = os.path.join(wd, "logs")
+    train_wd = os.path.join(wd, "train")
+    for d in (data_dir, log_dir, train_wd):
+        os.makedirs(d, exist_ok=True)
+    args.data_dir = data_dir
+
+    bench = {
+        "bench": "deploy_e2e",
+        "description": (
+            "Continuous-deployment cycle on a real two-replica tiny "
+            "fleet: a freshly trained checkpoint passes the offline "
+            "eval+parity gate, canaries behind the weighted session "
+            "split, and is promoted fleet-wide; a second candidate with "
+            "an injected canary SLO burn is auto-rolled-back with "
+            "sessions re-homed (restarted: true), zero failed requests "
+            "and the compile-count invariant intact throughout (CPU)."
+        ),
+        "config": {
+            "corpus_episodes": args.episodes,
+            "episode_steps": args.episode_steps,
+            "gate_tasks": args.gate_tasks,
+            "gate_episodes": args.gate_episodes,
+            "gate_max_steps": args.gate_max_steps,
+            "geometry": [SRC_H, SRC_W],
+        },
+    }
+
+    # ---- Corpus + first checkpoint (the incumbent).
+    paths = _build_corpus(data_dir, args.episodes, args.episode_steps)
+    pack_dir = pack_lib.default_pack_dir(data_dir, "train")
+    pack_lib.pack_episodes(paths, pack_dir, SRC_H, SRC_W, 0.95)
+    t0 = time.perf_counter()
+    _train_to(train_wd, data_dir, 2, os.path.join(log_dir, "train.log"))
+    bench["train_seed_seconds"] = round(time.perf_counter() - t0, 1)
+    print(json.dumps({"phase": "incumbent_trained"}), flush=True)
+
+    # ---- Episode 1: good candidate -> canary -> fleet-wide promote.
+    good = _fleet_episode(
+        "promote", args, train_wd, log_dir,
+        faults="", clean_window_ticks=4, next_train_steps=4,
+        wait_s=args.wait_s,
+    )
+    _assert_common(good)
+    assert good["terminal_event"]["event"] == "promoted", good[
+        "terminal_event"]
+    assert good["final_deploy"]["promotions_total"] == 1
+    assert good["final_deploy"]["rollbacks_total"] == 0
+    incumbent_0 = good["ready"]["deploy"]["incumbent_step"]
+    promoted_step = good["terminal_event"]["step"]
+    assert promoted_step > incumbent_0
+    assert good["final_deploy"]["incumbent_step"] == promoted_step
+    # Promote keeps sessions: nothing was orphaned, nothing restarted.
+    assert not good["traffic"]["restarts"], good["traffic"]["restarts"]
+    assert not good["post_sweep_restarted"]
+    assert "gate_passed" in _events(good)
+    assert any(v["passed"] for v in good["verdicts"])
+    bench["promote"] = good
+    print(json.dumps({"phase": "promote_done", "step": promoted_step}),
+          flush=True)
+
+    # ---- Episode 2: next candidate burns its canary SLO -> rollback.
+    bad = _fleet_episode(
+        "rollback", args, train_wd, log_dir,
+        faults="canary_slo_breach@4", clean_window_ticks=12,
+        next_train_steps=6, wait_s=args.wait_s,
+    )
+    _assert_common(bad)
+    assert bad["terminal_event"]["event"] == "rolled_back", bad[
+        "terminal_event"]
+    assert bad["terminal_event"]["reason"] == "slo_breach_injected"
+    assert bad["ready"]["deploy"]["incumbent_step"] == promoted_step
+    assert bad["final_deploy"]["rollbacks_total"] == 1
+    assert bad["final_deploy"]["promotions_total"] == 0
+    # The incumbent never moved, and the demoted replica restored it.
+    assert bad["final_deploy"]["incumbent_step"] == promoted_step
+    restore = bad["terminal_event"]["restore"]
+    assert restore["status"] == 200, restore
+    assert restore["checkpoint_step"] == promoted_step, restore
+    # Re-homing evidence: at least one canary-bound session surfaced
+    # `restarted: true` (in the live loop or the post-rollback sweep).
+    rehomed = (
+        len(bad["traffic"]["restarts"]) + len(bad["post_sweep_restarted"])
+    )
+    assert rehomed >= 1, (
+        bad["traffic"]["restarts"], bad["post_sweep_restarted"])
+    bench["rollback"] = bad
+    print(json.dumps({"phase": "rollback_done", "rehomed": rehomed}),
+          flush=True)
+
+    bench["total_seconds"] = round(time.perf_counter() - t_start, 1)
+    bench["verdict"] = "deploy_cycle_proven"
+    tmp = args.bench_out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bench, f, indent=2, sort_keys=True)
+    os.replace(tmp, args.bench_out)
+    print(json.dumps({"phase": "done", "bench_out": args.bench_out,
+                      "total_seconds": bench["total_seconds"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

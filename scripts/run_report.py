@@ -163,6 +163,19 @@ def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
     return out or None
 
 
+def load_deploy(workdir: str) -> Optional[Dict[str, Any]]:
+    """The continuous-deployment record (scripts/deploy_loop.py), or None
+    when the workdir has never run a deploy cycle."""
+    path = os.path.join(workdir, "BENCH_deploy.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None  # half-written record from a killed cycle
+
+
 def load_eval_matrix(workdir: str) -> Optional[Dict[str, Any]]:
     """The task × checkpoint eval-matrix record (scripts/eval_matrix.py),
     or None when the workdir has never run a sweep."""
@@ -471,6 +484,87 @@ def render_eval_matrix(record: Optional[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+_DEPLOY_EVENT_FIELDS = (
+    "step", "incumbent", "replica", "weight", "reason",
+    "previous_incumbent", "replicas", "error",
+)
+
+
+def render_deploy(record: Optional[Dict[str, Any]]) -> List[str]:
+    """The deployment section: per-episode promotion timeline (candidate
+    -> gate -> canary -> promote/rollback), traffic honesty counters,
+    and the signed-verdict table the gate left behind."""
+    lines = ["## Deployment (promotion controller)", ""]
+    if record is None:
+        lines.append(
+            "BENCH_deploy.json not found — no deploy cycle has run "
+            "against this workdir (scripts/deploy_loop.py)."
+        )
+        return lines
+    episodes = [
+        record[k] for k in ("promote", "rollback") if record.get(k)
+    ]
+    if not episodes:
+        lines.append("Record present but empty (cycle died before a "
+                     "fleet episode).")
+        return lines
+    lines.append(
+        f"Verdict {record.get('verdict', '?')!r} in "
+        f"{record.get('total_seconds', 0.0):.1f} s ({len(episodes)} fleet "
+        f"episode(s), gate tasks "
+        f"{record.get('config', {}).get('gate_tasks', '?')!r})."
+    )
+    verdict_rows = []
+    for ep in episodes:
+        deploy = ep.get("final_deploy") or {}
+        traffic = ep.get("traffic") or {}
+        lines.append("")
+        lines.append(
+            f"[{ep.get('episode', '?')}] faults={ep.get('faults') or 'none'}"
+            f" — incumbent {deploy.get('incumbent_step', '?')}, "
+            f"{deploy.get('promotions_total', 0)} promotion(s), "
+            f"{deploy.get('rollbacks_total', 0)} rollback(s)."
+        )
+        for entry in ep.get("timeline", []):
+            detail = " ".join(
+                f"{k}={entry[k]}"
+                for k in _DEPLOY_EVENT_FIELDS
+                if k in entry
+            )
+            lines.append(
+                f"  tick {entry.get('tick', '?'):>4}  "
+                f"{entry.get('event', '?'):<18}{detail}"
+            )
+        rehomed = len(traffic.get("restarts", [])) + len(
+            ep.get("post_sweep_restarted", [])
+        )
+        lines.append(
+            f"  traffic: {traffic.get('requests_ok', 0)} ok, "
+            f"{len(traffic.get('failures', []))} failed, "
+            f"{rehomed} re-homed (restarted: true), "
+            f"{traffic.get('sessions_created', 0)} session(s)."
+        )
+        verdict_rows.extend(ep.get("verdicts", []))
+    if verdict_rows:
+        lines.append("")
+        lines.append(
+            f"{'verdict artifact':<28}{'candidate':>10}{'incumbent':>10}"
+            f"{'passed':>8}{'signature':>11}"
+        )
+        for row in verdict_rows:
+            lines.append(
+                f"{str(row.get('path', '?')):<28}"
+                f"{str(row.get('candidate_step', '?')):>10}"
+                f"{str(row.get('incumbent_step', '?')):>10}"
+                f"{str(bool(row.get('passed'))):>8}"
+                + (
+                    f"{'ok':>11}" if row.get("signature_ok")
+                    else f"{'INVALID':>11}"
+                )
+            )
+    return lines
+
+
 def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     """The serve post-mortem: SLO verdict, per-class outcome table,
     fleet/chaos evidence from the BENCH record, slowest exemplars."""
@@ -709,6 +803,7 @@ def render_report(
     serve: Optional[Dict[str, Any]] = None,
     eval_matrix: Optional[Dict[str, Any]] = None,
     multichip: Optional[Dict[str, Any]] = None,
+    deploy: Optional[Dict[str, Any]] = None,
 ) -> str:
     sections = [
         [f"# RT-1 run report — {workdir}", ""],
@@ -735,6 +830,11 @@ def render_report(
     if serve is not None:
         sections.insert(1, [""])
         sections.insert(1, render_serve(serve, tail=tail))
+    if deploy is not None:
+        # Ahead of the serve post-mortem: what the fleet is serving (and
+        # how it got there) frames the SLO story below it.
+        sections.insert(1, [""])
+        sections.insert(1, render_deploy(deploy))
     return "\n".join(line for sec in sections for line in sec)
 
 
@@ -760,6 +860,7 @@ def main(argv=None):
         serve=load_serve(args.workdir),
         eval_matrix=load_eval_matrix(args.workdir),
         multichip=load_multichip(args.workdir, args.multichip),
+        deploy=load_deploy(args.workdir),
     )
     if args.out:
         with open(args.out, "w") as f:

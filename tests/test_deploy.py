@@ -1,0 +1,537 @@
+"""Continuous deployment subsystem (ISSUE 16): eval-gated promotion,
+router-weighted canary, SLO-burn auto-rollback.
+
+Three layers, mirroring the subsystem's own split:
+
+* pure units — the `CanaryJudge` burn-window decision fn, the
+  torn-dir-tolerant checkpoint watcher (pinned to the trainer's
+  `latest_step` on the same canned directory), signed verdict artifacts;
+* router mechanism — deterministic Bresenham weighted placement and the
+  demote/re-home path, on in-process stub replicas;
+* the full stub-fleet deploy cycle — a good candidate canaried then
+  promoted fleet-wide, a bad candidate (chaos ``canary_slo_breach``)
+  auto-rolled-back with zero failed requests, and a failed fleet-wide
+  promote (chaos ``promote``) rolled back with the incumbent untouched.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from rt1_tpu.deploy.controller import PromotionController
+from rt1_tpu.deploy.decision import CanaryJudge, CanaryPolicy, CanarySignals
+from rt1_tpu.deploy.watcher import CheckpointWatcher, latest_checkpoint_step
+from rt1_tpu.deploy import verdict as verdict_lib
+from rt1_tpu.resilience import faults
+from rt1_tpu.serve.router import NOTREADY, READY, Replica, Router
+from rt1_tpu.serve.stub import StubReplicaApp, make_stub_server
+
+
+# ------------------------------------------------------------------ decision
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        CanaryPolicy(burn_threshold=0.0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(breach_ticks=0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(clean_window_ticks=0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(min_canary_requests=-1)
+    with pytest.raises(ValueError):
+        CanaryPolicy(canary_weight=0.0)
+    with pytest.raises(ValueError):
+        CanaryPolicy(canary_weight=1.5)
+
+
+def _signals(requests=100, burn=0.0, fleet=0.0, ready=True):
+    return CanarySignals(
+        canary_requests=requests,
+        canary_burn=burn,
+        fleet_burn=fleet,
+        canary_ready=ready,
+    )
+
+
+def test_judge_promotes_after_clean_window():
+    judge = CanaryJudge(CanaryPolicy(clean_window_ticks=3))
+    assert judge.decide(_signals()) == "hold"
+    assert judge.decide(_signals()) == "hold"
+    assert judge.decide(_signals()) == "promote"
+    assert judge.clean_streak == 3
+
+
+def test_judge_rolls_back_after_consecutive_breaches():
+    judge = CanaryJudge(CanaryPolicy(breach_ticks=2, burn_threshold=2.0))
+    assert judge.decide(_signals(burn=5.0)) == "hold"
+    assert judge.decide(_signals(burn=5.0)) == "rollback"
+
+
+def test_judge_breach_streak_resets_on_clean_tick():
+    judge = CanaryJudge(CanaryPolicy(breach_ticks=2, clean_window_ticks=99))
+    assert judge.decide(_signals(burn=5.0)) == "hold"
+    assert judge.decide(_signals(burn=0.0)) == "hold"  # blip forgiven
+    assert judge.breach_streak == 0
+    assert judge.decide(_signals(burn=5.0)) == "hold"  # streak restarts
+
+
+def test_judge_evidence_floor_holds_without_advancing_streaks():
+    judge = CanaryJudge(
+        CanaryPolicy(clean_window_ticks=1, min_canary_requests=8)
+    )
+    for _ in range(5):
+        assert judge.decide(_signals(requests=3)) == "hold"
+    assert judge.clean_streak == 0
+    # ...but a breach needs no more evidence to be condemned.
+    judge2 = CanaryJudge(
+        CanaryPolicy(breach_ticks=1, min_canary_requests=8)
+    )
+    assert judge2.decide(_signals(requests=0, burn=9.0)) == "rollback"
+
+
+def test_judge_fleet_wide_incident_never_scapegoats_canary():
+    judge = CanaryJudge(CanaryPolicy(breach_ticks=1, burn_threshold=2.0))
+    # Canary over threshold but NOT strictly above the fleet: not a breach.
+    assert judge.decide(_signals(burn=5.0, fleet=5.0)) == "hold"
+    assert judge.breach_streak == 0
+    # Strictly above the fleet: breach.
+    assert judge.decide(_signals(burn=5.0, fleet=4.0)) == "rollback"
+
+
+def test_judge_unroutable_canary_is_a_breach():
+    judge = CanaryJudge(CanaryPolicy(breach_ticks=1))
+    assert judge.decide(_signals(ready=False)) == "rollback"
+
+
+# ------------------------------------------------------------------- watcher
+
+
+def _make_ckpt(root, step, complete=True):
+    d = os.path.join(root, str(step))
+    os.makedirs(d, exist_ok=True)
+    if complete:
+        with open(os.path.join(d, "checkpoint"), "w") as f:
+            f.write("x")
+    return d
+
+
+def test_latest_checkpoint_step_matches_trainer_latest_step(tmp_path):
+    """The deploy watcher is an import-light twin of
+    `trainer.checkpoints.latest_step`; this pins the two implementations
+    to identical answers on the same adversarial directory."""
+    from rt1_tpu.trainer.checkpoints import latest_step as trainer_latest
+
+    root = str(tmp_path / "checkpoints")
+    cases = []
+    cases.append(("missing dir", root))
+    os.makedirs(root)
+    cases.append(("empty dir", root))
+    _make_ckpt(root, 2)
+    cases.append(("one step", root))
+    _make_ckpt(root, 10)
+    _make_ckpt(root, 5)
+    cases.append(("several steps", root))
+    # Orbax in-flight tmp dir: must not count as step 20.
+    os.makedirs(os.path.join(root, "20.orbax-checkpoint-tmp-1234"))
+    cases.append(("orbax tmp dir", root))
+    # Torn write: mkdir landed, contents didn't.
+    _make_ckpt(root, 30, complete=False)
+    cases.append(("empty step dir", root))
+    # Digit-named FILE (not a dir) and a sidecar file.
+    with open(os.path.join(root, "40"), "w") as f:
+        f.write("not a dir")
+    with open(os.path.join(root, "ckpt_metadata"), "w") as f:
+        f.write("{}")
+    cases.append(("digit-named file", root))
+    for label, d in cases:
+        assert latest_checkpoint_step(d) == trainer_latest(d), label
+    assert latest_checkpoint_step(root) == 10
+
+
+def test_watcher_surfaces_each_step_once(tmp_path):
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    watcher = CheckpointWatcher(workdir)
+    assert watcher.poll() is None
+    os.makedirs(root)
+    _make_ckpt(root, 2)
+    assert watcher.poll() == 2
+    assert watcher.poll() is None  # surfaced exactly once
+    _make_ckpt(root, 4)
+    assert watcher.poll() == 4
+    assert watcher.pending_steps() == [2, 4]
+
+
+def test_watcher_seeded_high_water_skips_incumbent(tmp_path):
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    os.makedirs(root)
+    _make_ckpt(root, 2)
+    watcher = CheckpointWatcher(workdir, seen_through=2)
+    assert watcher.poll() is None  # the incumbent is not a candidate
+    _make_ckpt(root, 4)
+    assert watcher.poll() == 4
+    watcher.dismiss(6)
+    _make_ckpt(root, 6)
+    assert watcher.poll() is None
+
+
+# ------------------------------------------------------------------- verdict
+
+
+def test_verdict_sign_write_verify_roundtrip(tmp_path):
+    path = str(tmp_path / "deploy" / "verdict_4.json")
+    key = verdict_lib.signing_key(str(tmp_path / "deploy"))
+    # Key file generated once, 0600, stable across calls.
+    keyfile = tmp_path / "deploy" / "deploy_key"
+    assert keyfile.exists()
+    assert (keyfile.stat().st_mode & 0o777) == 0o600
+    assert verdict_lib.signing_key(str(tmp_path / "deploy")) == key
+
+    signed = verdict_lib.write_verdict(
+        path, {"passed": True, "candidate_step": 4}, key
+    )
+    assert signed["signature"]
+    payload, ok = verdict_lib.verify_verdict(path, key)
+    assert ok and payload["passed"] is True
+
+    # Tampering with the payload breaks the signature.
+    payload["passed"] = False
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    _, ok = verdict_lib.verify_verdict(path, key)
+    assert not ok
+    # Missing / torn files verify False instead of raising.
+    assert verdict_lib.verify_verdict(str(tmp_path / "nope.json"), key) == (
+        None,
+        False,
+    )
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert verdict_lib.verify_verdict(path, key) == (None, False)
+
+
+def test_verdict_env_key_overrides_keyfile(tmp_path, monkeypatch):
+    monkeypatch.setenv(verdict_lib.ENV_KEY, "fleet-secret")
+    assert verdict_lib.signing_key(str(tmp_path)) == "fleet-secret"
+    assert not (tmp_path / "deploy_key").exists()
+
+
+# ------------------------------------------------------- router canary seam
+
+
+@pytest.fixture()
+def fleet():
+    apps, servers = [], []
+    router = Router(replica_timeout_s=5.0)
+    for rid in range(2):
+        app = StubReplicaApp(replica_id=rid)
+        httpd = make_stub_server(app)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        host, port = httpd.server_address[:2]
+        replica = router.add_replica(Replica(rid, url=f"http://{host}:{port}"))
+        replica.state = READY
+        apps.append(app)
+        servers.append(httpd)
+    yield router, apps
+    faults.clear()
+    for httpd in servers:
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+def _act(router, session_id):
+    return router.route_act({"session_id": session_id, "image_b64": "AAAA"})
+
+
+def test_weighted_placement_is_deterministic(fleet):
+    router, _ = fleet
+    router.set_canary(1, 0.25)
+    placements = []
+    for i in range(8):
+        status, body = _act(router, f"w{i}")
+        assert status == 200
+        placements.append(body["replica_id"])
+    # Bresenham at w=0.25: exactly fresh placements 4 and 8 (n=3, n=7)
+    # land on the canary — no RNG, same split every run.
+    assert placements == [0, 0, 0, 1, 0, 0, 0, 1]
+    assert router.canary_status()["fresh_placements"] == 8
+    # Existing sessions keep their affinity: re-acting every session
+    # advances no Bresenham state and moves no session.
+    again = []
+    for i in range(8):
+        status, body = _act(router, f"w{i}")
+        again.append(body["replica_id"])
+    assert again == placements
+    assert router.canary_status()["fresh_placements"] == 8
+
+
+def test_clear_canary_keeps_sessions_demote_evicts(fleet):
+    router, _ = fleet
+    router.set_canary(1, 1.0)  # every fresh session -> canary
+    status, body = _act(router, "keep")
+    assert body["replica_id"] == 1
+    assert router.clear_canary() == 1
+    # PROMOTE path: the session stays where it is, no restart.
+    status, body = _act(router, "keep")
+    assert body["replica_id"] == 1 and "restarted" not in body
+
+    router.set_canary(1, 1.0)
+    status, body = _act(router, "evict")
+    assert body["replica_id"] == 1
+    assert router.demote_canary() == 1
+    # ROLLBACK path: the session re-homes with restarted:true, never 5xx.
+    status, body = _act(router, "evict")
+    assert status == 200
+    assert body["restarted"] is True
+
+
+def test_not_ready_canary_drops_out_of_the_split(fleet):
+    router, _ = fleet
+    router.set_canary(1, 1.0)
+    router.set_state(1, NOTREADY)
+    for i in range(3):
+        status, body = _act(router, f"n{i}")
+        assert status == 200 and body["replica_id"] == 0
+
+
+def test_reload_one_swaps_a_single_replica(fleet):
+    router, apps = fleet
+    entry = router.reload_one(1, 7)
+    assert entry["status"] == 200 and entry["recovered"] is True
+    assert apps[1].checkpoint_step == 7
+    assert apps[0].checkpoint_step == -1  # untouched
+    assert router.reload_one(99, 7)["skipped"] == "unknown"
+
+
+# --------------------------------------------------- controller deploy cycle
+
+
+def _controller(router, workdir, **overrides):
+    policy = CanaryPolicy(
+        breach_ticks=2,
+        clean_window_ticks=2,
+        min_canary_requests=2,
+        canary_weight=0.5,
+    )
+    kwargs = dict(gate_fn=_auto_pass, policy=policy, incumbent_step=2)
+    kwargs.update(overrides)
+    return PromotionController(router, workdir, **kwargs)
+
+
+def _auto_pass(candidate_step, incumbent_step):
+    return {
+        "gate": "auto",
+        "passed": True,
+        "candidate_step": candidate_step,
+        "incumbent_step": incumbent_step,
+    }
+
+
+def _events(controller):
+    return [e["event"] for e in controller.timeline]
+
+
+def test_good_candidate_canaried_then_promoted_fleet_wide(fleet, tmp_path):
+    router, apps = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    _make_ckpt(root, 2)
+    controller = _controller(router, workdir)
+
+    controller.tick()  # nothing new: the incumbent is not a candidate
+    assert controller.state == "idle" and controller.candidates_seen == 0
+
+    _make_ckpt(root, 4)
+    controller.tick()
+    # Candidate gated, signed verdict written, canary loaded on the
+    # highest-id replica at the configured weight.
+    assert _events(controller) == ["candidate", "gate_passed",
+                                   "canary_started"]
+    assert controller.state == "canary"
+    assert apps[1].checkpoint_step == 4
+    assert apps[0].checkpoint_step == -1  # incumbent fleet untouched
+    assert router.canary_status() == {
+        "replica_id": 1, "weight": 0.5, "fresh_placements": 0,
+    }
+    payload, ok = verdict_lib.verify_verdict(
+        controller.verdict_paths[0], controller.signing_key
+    )
+    assert ok and payload["passed"] and payload["candidate_step"] == 4
+
+    # Fresh sessions split between canary and incumbent (w=0.5).
+    for i in range(6):
+        status, _ = _act(router, f"s{i}")
+        assert status == 200
+    assert router.replica_slo_snapshot()[1]["requests_total"] == 3
+
+    controller.tick()  # clean tick 1: hold
+    assert controller.state == "canary"
+    controller.tick()  # clean tick 2: promote fleet-wide
+    assert controller.state == "idle"
+    assert controller.promotions == 1
+    assert controller.incumbent_step == 4
+    assert apps[0].checkpoint_step == 4  # rolling reload reached everyone
+    assert apps[1].checkpoint_step == 4
+    assert router.canary_status()["replica_id"] is None
+    assert _events(controller)[-1] == "promoted"
+    # Canary sessions stayed (already on the promoted params): acting
+    # again restarts nothing.
+    for i in range(6):
+        status, body = _act(router, f"s{i}")
+        assert status == 200 and "restarted" not in body
+    # Zero failed requests; compile pinned at bucket count throughout.
+    assert router.slo.gauges()["slo_requests_failed"] == 0
+    for app in apps:
+        assert app.compile_count == len(app.buckets)
+
+
+def test_bad_candidate_rolled_back_on_injected_breach(fleet, tmp_path):
+    router, apps = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    _make_ckpt(root, 2)
+    _make_ckpt(root, 4)
+    controller = _controller(router, workdir, incumbent_step=4)
+    apps[0].checkpoint_step = 4
+    apps[1].checkpoint_step = 4
+
+    faults.install_from("canary_slo_breach@1")
+    _make_ckpt(root, 6)
+    controller.tick()
+    assert controller.state == "canary"
+    assert apps[1].checkpoint_step == 6
+    # A session lands on the canary before the breach verdict.
+    status, body = _act(router, "victim")  # n=0 -> incumbent
+    status, body = _act(router, "canary-bound")  # n=1 -> canary
+    assert body["replica_id"] == 1
+
+    controller.tick()  # breach tick 1 (latched synthetic): hold
+    assert controller.state == "canary"
+    assert controller.watch_log[-1]["synthetic_breach"] is True
+    controller.tick()  # breach tick 2: rollback
+    assert controller.state == "idle"
+    assert controller.rollbacks == 1
+    assert controller.promotions == 0
+    assert controller.incumbent_step == 4
+    rolled = controller.timeline[-1]
+    assert rolled["event"] == "rolled_back"
+    assert rolled["reason"] == "slo_breach_injected"
+    # The canary replica is back on the incumbent; the rest of the fleet
+    # was never touched.
+    assert apps[1].checkpoint_step == 4
+    assert apps[0].checkpoint_step == 4
+    # The canary's session re-homes with restarted:true — never a 5xx —
+    # and the incumbent session never notices.
+    status, body = _act(router, "canary-bound")
+    assert status == 200 and body["restarted"] is True
+    status, body = _act(router, "victim")
+    assert status == 200 and "restarted" not in body
+    assert router.slo.gauges()["slo_requests_failed"] == 0
+    for app in apps:
+        assert app.compile_count == len(app.buckets)
+
+
+def test_failed_promote_rolls_back_fleet_wide(fleet, tmp_path):
+    router, apps = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    _make_ckpt(root, 2)
+    controller = _controller(router, workdir)
+    apps[0].checkpoint_step = 2
+    apps[1].checkpoint_step = 2
+
+    faults.install_from("promote@1")
+    _make_ckpt(root, 4)
+    controller.tick()
+    assert controller.state == "canary"
+    for i in range(4):
+        _act(router, f"p{i}")
+    controller.tick()
+    controller.tick()  # promote decision -> injected OSError -> rollback
+    assert controller.state == "idle"
+    assert controller.promotions == 0
+    assert controller.rollbacks == 1
+    assert controller.incumbent_step == 2  # incumbent untouched
+    assert "promote_failed" in _events(controller)
+    # Fleet-wide restore: every replica serves the incumbent again.
+    assert apps[0].checkpoint_step == 2
+    assert apps[1].checkpoint_step == 2
+    assert router.slo.gauges()["slo_requests_failed"] == 0
+
+
+def test_gate_rejection_keeps_fleet_untouched(fleet, tmp_path):
+    router, apps = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    _make_ckpt(root, 2)
+
+    def reject(candidate_step, incumbent_step):
+        return {"passed": False, "candidate_mean_success": 0.0}
+
+    controller = _controller(router, workdir, gate_fn=reject)
+    _make_ckpt(root, 4)
+    controller.tick()
+    assert controller.state == "idle"
+    assert controller.gates_failed == 1
+    assert _events(controller) == ["candidate", "gate_rejected"]
+    assert apps[1].checkpoint_step == -1  # never canaried
+    # The rejection is recorded as a signed verdict too.
+    payload, ok = verdict_lib.verify_verdict(
+        controller.verdict_paths[0], controller.signing_key
+    )
+    assert ok and payload["passed"] is False
+
+
+def test_crashing_gate_is_a_rejection_not_a_crash(fleet, tmp_path):
+    router, _ = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+
+    def explode(candidate_step, incumbent_step):
+        raise RuntimeError("gate OOM")
+
+    controller = _controller(router, workdir, gate_fn=explode)
+    _make_ckpt(root, 4)
+    controller.tick()
+    assert controller.state == "idle"
+    assert controller.gates_failed == 1
+    payload, ok = verdict_lib.verify_verdict(
+        controller.verdict_paths[0], controller.signing_key
+    )
+    assert ok and payload["passed"] is False and "gate OOM" in payload["error"]
+
+
+def test_no_canary_capacity_holds_candidate(fleet, tmp_path):
+    router, apps = fleet
+    workdir = str(tmp_path)
+    root = os.path.join(workdir, "checkpoints")
+    router.set_state(1, NOTREADY)  # one ready replica: no headroom
+    controller = _controller(router, workdir)
+    _make_ckpt(root, 4)
+    controller.tick()
+    assert controller.state == "idle"
+    assert "canary_unplaceable" in _events(controller)
+    assert apps[1].checkpoint_step == -1
+
+
+def test_deploy_gauges_shape(fleet, tmp_path):
+    router, _ = fleet
+    controller = _controller(router, str(tmp_path))
+    gauges = controller.deploy_gauges()
+    assert gauges["state"] == "idle"
+    assert gauges["incumbent_step"] == 2
+    assert gauges["candidate_step"] == -1
+    assert gauges["canary_replica_id"] == -1
+    for key, value in gauges.items():
+        if key != "state":
+            assert isinstance(value, (int, float)), key
+    summary = controller.summary()
+    assert summary["policy"]["canary_weight"] == 0.5
+    assert summary["timeline"] == [] and summary["verdicts"] == []

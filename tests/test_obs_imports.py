@@ -323,6 +323,49 @@ assert 'rt1_serve_task_requests_total{task="unknown:probe"} 1' in ttext
 assert 'rt1_serve_task_requests_total{task="unlabeled"} 1' in ttext
 assert 'rt1_serve_task_sessions_total{task="unknown:probe"} 1' in ttext
 
+# ISSUE 16 continuous deployment: the promotion controller lives inside
+# the fleet supervisor process — the whole rt1_tpu.deploy package (state
+# machine, burn-window judge, checkpoint watcher, signed verdicts) and
+# its rt1_deploy_* exposition must work under the blocker. Only CALLING
+# the real gate (deploy/gate.py internals) pays the jax context.
+import rt1_tpu.deploy as deploy
+
+judge16 = deploy.CanaryJudge(deploy.CanaryPolicy(clean_window_ticks=1))
+from rt1_tpu.deploy.decision import CanarySignals
+
+assert judge16.decide(
+    CanarySignals(canary_requests=100, canary_burn=0.0)) == "promote"
+assert deploy.latest_checkpoint_step("/nonexistent/ckpts") is None
+
+from rt1_tpu.deploy import verdict as verdict16
+
+with _tempfile.TemporaryDirectory() as _vd:
+    _vp = _vd + "/verdict_1.json"
+    verdict16.write_verdict(_vp, {"passed": True}, "probe-key")
+    _pay, _ok = verdict16.verify_verdict(_vp, "probe-key")
+    assert _ok and _pay["passed"]
+
+from rt1_tpu.deploy.controller import PromotionController
+from rt1_tpu.obs.prometheus import render_deploy_snapshot
+
+with _tempfile.TemporaryDirectory() as _dw:
+    ctrl16 = PromotionController(
+        Router(), _dw, gate_fn=lambda c, i: {"passed": True})
+    ctrl16.tick()
+    dtext = render_deploy_snapshot(ctrl16.deploy_gauges())
+assert 'rt1_deploy_state{state="idle"} 1' in dtext
+assert "# TYPE rt1_deploy_candidates_seen_total counter" in dtext
+assert "rt1_deploy_canary_weight 0.25" in dtext
+
+# The router's canary seam is part of the same jax-free surface.
+router16 = Router()
+from rt1_tpu.serve.router import Replica as _Replica
+
+router16.add_replica(_Replica(0))
+router16.set_canary(0, 0.5)
+assert router16.canary_status()["weight"] == 0.5
+assert router16.clear_canary() == 0
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

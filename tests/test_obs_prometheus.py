@@ -874,3 +874,47 @@ def test_flywheel_and_capture_naming_contract():
     # listener's composition path).
     combined = prom.render_scalar_gauges({"stall_pct": 1.0}) + fly
     parse_exposition(combined)
+
+
+def test_deploy_families_naming_contract():
+    """ISSUE 16: every PromotionController gauge renders under the
+    rt1_deploy_* prefix with the right type — strings info-style,
+    *_total counters, the rest gauges — and `deploy_metric_names`
+    enumerates exactly the rendered families."""
+    from rt1_tpu.deploy.controller import PromotionController
+    from rt1_tpu.serve.router import Router
+
+    controller = PromotionController(
+        Router(),
+        "/tmp/rt1-deploy-naming-contract",
+        gate_fn=lambda c, i: {"passed": True},
+        incumbent_step=2,
+    )
+    snapshot = controller.deploy_gauges()
+    text = prom.render_deploy_snapshot(snapshot)
+    types, samples = parse_exposition(text)
+    assert set(types) == set(prom.deploy_metric_names(snapshot))
+    for name, mtype in types.items():
+        assert name.startswith("rt1_deploy_"), name
+        if name.endswith("_total"):
+            assert mtype == "counter", name
+        else:
+            assert mtype == "gauge", name
+    # The state string renders info-style with the value as a label.
+    assert ("rt1_deploy_state", {"state": "idle"}, "1") in samples
+    assert ("rt1_deploy_incumbent_step", {}, "2") in samples
+    assert ("rt1_deploy_canary_replica_id", {}, "-1") in samples
+    assert types["rt1_deploy_promotions_total"] == "counter"
+    assert types["rt1_deploy_rollbacks_total"] == "counter"
+    assert types["rt1_deploy_candidates_seen_total"] == "counter"
+    assert types["rt1_deploy_canary_weight"] == "gauge"
+
+    # Attached to a router, the deploy families ride the ONE fleet scrape
+    # (and stay absent when no controller is armed).
+    router = Router()
+    assert "rt1_deploy_" not in router.fleet_metrics_prometheus()
+    router.deploy_gauges_fn = controller.deploy_gauges
+    combined = router.fleet_metrics_prometheus()
+    parse_exposition(combined)
+    assert "rt1_deploy_state" in combined
+    assert router.fleet_metrics_snapshot()["deploy"]["state"] == "idle"

@@ -595,3 +595,137 @@ def test_main_renders_serve_section(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "Serve post-mortem" in out
     assert "Availability 99.600%" in out
+
+
+def _canned_deploy_workdir(tmp_path):
+    """A workdir as scripts/deploy_loop.py leaves it: one promoted and
+    one rolled-back fleet episode in BENCH_deploy.json."""
+    wd = tmp_path / "deploy-run"
+    wd.mkdir()
+    record = {
+        "bench": "deploy_e2e",
+        "verdict": "deploy_cycle_proven",
+        "total_seconds": 812.4,
+        "config": {"gate_tasks": "block2block"},
+        "promote": {
+            "episode": "promote",
+            "faults": None,
+            "final_deploy": {
+                "incumbent_step": 4,
+                "promotions_total": 1,
+                "rollbacks_total": 0,
+            },
+            "timeline": [
+                {"tick": 3, "event": "candidate", "step": 4, "incumbent": 2},
+                {"tick": 3, "event": "gate_passed", "step": 4},
+                {"tick": 3, "event": "canary_started", "step": 4,
+                 "replica": 1, "weight": 0.5},
+                {"tick": 9, "event": "promoted", "step": 4,
+                 "previous_incumbent": 2, "replicas": 2},
+            ],
+            "traffic": {
+                "requests_ok": 1480, "failures": [], "restarts": [],
+                "sessions_created": 31,
+            },
+            "post_sweep_restarted": [],
+            "verdicts": [
+                {"path": "deploy/verdict_4.json", "candidate_step": 4,
+                 "incumbent_step": 2, "passed": True, "signature_ok": True},
+            ],
+        },
+        "rollback": {
+            "episode": "rollback",
+            "faults": "canary_slo_breach@4",
+            "final_deploy": {
+                "incumbent_step": 4,
+                "promotions_total": 0,
+                "rollbacks_total": 1,
+            },
+            "timeline": [
+                {"tick": 2, "event": "candidate", "step": 6, "incumbent": 4},
+                {"tick": 2, "event": "gate_passed", "step": 6},
+                {"tick": 2, "event": "canary_started", "step": 6,
+                 "replica": 1, "weight": 0.5},
+                {"tick": 8, "event": "rolled_back", "step": 6, "replica": 1,
+                 "reason": "slo_breach_injected", "incumbent": 4},
+            ],
+            "traffic": {
+                "requests_ok": 960,
+                "failures": [],
+                "restarts": [{"session": "probe-9", "unix_time": 1.0}],
+                "sessions_created": 22,
+            },
+            "post_sweep_restarted": ["probe-11"],
+            "verdicts": [
+                {"path": "deploy/verdict_6.json", "candidate_step": 6,
+                 "incumbent_step": 4, "passed": True, "signature_ok": True},
+            ],
+        },
+    }
+    with open(wd / "BENCH_deploy.json", "w") as f:
+        json.dump(record, f)
+    return str(wd)
+
+
+def test_deploy_section_renders_timeline_and_verdicts(tmp_path):
+    """ISSUE 16 satellite: BENCH_deploy.json renders as the promotion
+    timeline + signed-verdict table, ahead of the serve post-mortem."""
+    wd = _canned_deploy_workdir(tmp_path)
+    deploy = run_report.load_deploy(wd)
+    assert deploy is not None
+    report = run_report.render_report(wd, None, None, None, deploy=deploy)
+
+    assert "## Deployment (promotion controller)" in report
+    assert (
+        "Verdict 'deploy_cycle_proven' in 812.4 s (2 fleet episode(s), "
+        "gate tasks 'block2block')." in report
+    )
+    lines = report.splitlines()
+    # Both episodes, each with its headline and timeline rows.
+    promote_hdr = next(ln for ln in lines if ln.startswith("[promote]"))
+    assert "faults=none" in promote_hdr
+    assert "incumbent 4, 1 promotion(s), 0 rollback(s)." in promote_hdr
+    rollback_hdr = next(ln for ln in lines if ln.startswith("[rollback]"))
+    assert "faults=canary_slo_breach@4" in rollback_hdr
+    assert "0 promotion(s), 1 rollback(s)." in rollback_hdr
+    assert (
+        "  tick    3  canary_started    step=4 replica=1 weight=0.5"
+        in lines
+    )
+    assert (
+        "  tick    9  promoted          step=4 previous_incumbent=2 "
+        "replicas=2" in lines
+    )
+    rolled = next(
+        ln for ln in lines if "rolled_back" in ln and "tick" in ln
+    )
+    assert "reason=slo_breach_injected" in rolled
+    # Traffic honesty: re-homed count folds live restarts + post sweep.
+    promote_traffic = next(
+        ln for ln in lines if "1480 ok" in ln
+    )
+    assert "0 failed, 0 re-homed" in promote_traffic
+    rollback_traffic = next(ln for ln in lines if "960 ok" in ln)
+    assert "2 re-homed (restarted: true)" in rollback_traffic
+    # The signed-verdict table.
+    v4 = next(ln for ln in lines if ln.startswith("deploy/verdict_4.json"))
+    assert "ok" in v4 and "True" in v4
+    assert any(ln.startswith("deploy/verdict_6.json") for ln in lines)
+
+
+def test_deploy_section_absent_without_record(tmp_path):
+    """A workdir with no BENCH_deploy.json renders no deployment section
+    — the golden training report stays byte-stable."""
+    wd = _canned_workdir(tmp_path)
+    assert run_report.load_deploy(wd) is None
+    report = run_report.render_report(
+        wd, run_report.load_goodput(wd), run_report.load_flight(wd), None
+    )
+    assert "Deployment (promotion controller)" not in report
+
+
+def test_deploy_loader_tolerates_torn_record(tmp_path):
+    wd = tmp_path / "torn"
+    wd.mkdir()
+    (wd / "BENCH_deploy.json").write_text('{"bench": "deploy_e2e", ')
+    assert run_report.load_deploy(str(wd)) is None
