@@ -106,6 +106,16 @@ def main():
              "native int8 matmul — there the byte column is the measured "
              "win and TPU latency is the projection.")
     p.add_argument(
+        "--window_sweep", default="",
+        help="infer mode: comma window lengths (e.g. '3,6,15') to A/B the "
+             "full-window infer_step against the KV-cached "
+             "infer_step_cached at each length (interleaved windows, "
+             "alternating side order per round, floor medians — the "
+             "quant-A/B methodology). Writes BENCH_serve_kvcache.json "
+             "next to this script. Headline: cached per-step latency "
+             "stays near-flat across window lengths (O(frame) work) "
+             "while the windowed path grows O(window).")
+    p.add_argument(
         "--guard", action="store_true",
         help="e2e mode: after the headline measurement, re-run the same "
              "loop through the guard-enabled train step (rt1_tpu/resilience "
@@ -1001,6 +1011,8 @@ def infer_bench(args, model, rng, obs, actions, build_model_fn=None):
     )
     if args.inference_dtype:
         _infer_quant_ab(args, model, variables, frame, build_model_fn)
+    if args.window_sweep:
+        _infer_kvcache_sweep(args, build_model_fn)
     _dump_host_trace()
 
 
@@ -1117,6 +1129,162 @@ def _infer_quant_ab(args, model, variables, frame, build_model_fn=None):
         ),
         file=sys.stderr,
     )
+
+
+def _infer_kvcache_sweep(args, build_model_fn):
+    """Cached-vs-windowed control-step latency across window lengths
+    (ISSUE 17): at each `--window_sweep` length T, A/B the full-window
+    `infer_step` against the KV-cached `infer_step_cached` with the
+    interleaved-window methodology (alternating side order per round,
+    best-of floor medians per side). The cached side is warmed past
+    roll-over so it measures the steady shift-and-decode regime, not the
+    (cheaper-looking) fill phase. Writes `BENCH_serve_kvcache.json` next
+    to this script; the acceptance shape is a near-flat cached column
+    while the windowed column grows with T."""
+    import functools
+    import statistics
+    import sys
+
+    import jax
+
+    from rt1_tpu.specs import language_table_action_space, sample_space
+
+    windows = sorted(
+        {int(w) for w in args.window_sweep.split(",") if w.strip()}
+    )
+    rng = jax.random.PRNGKey(0)
+    frame = {
+        "image": jax.random.uniform(rng, (1, args.height, args.width, 3)),
+        "natural_language_embedding": jax.random.normal(
+            jax.random.fold_in(rng, 1), (1, 512)
+        ),
+    }
+    rounds = 4
+    window_steps = max(args.steps // rounds, 8)
+    per_window = {}
+    for seq_len in windows:
+        m = build_model_fn(args.dtype).clone(time_sequence_length=seq_len)
+        # Param shapes are window-independent (the position table is a
+        # fixed max_seq_len=256 rows), so init at one frame of context —
+        # the same startup trick as infer_bench. Both sides share one
+        # variable tree: the decode branch reuses the training path's
+        # submodule names, so the param trees are identical.
+        m1 = m.clone(time_sequence_length=1)
+        obs1 = {
+            "image": frame["image"][:, None],
+            "natural_language_embedding": (
+                frame["natural_language_embedding"][:, None]
+            ),
+        }
+        actions1 = sample_space(
+            language_table_action_space(), jax.random.fold_in(rng, 2), (1, 1)
+        )
+        variables = m1.init(
+            {"params": rng, "crop": rng}, obs1, actions1, train=False
+        )
+
+        def make_step(method, model=m):
+            @functools.partial(jax.jit, donate_argnums=(2,))
+            def step(v, observation, state):
+                return model.apply(v, observation, state, method=method)
+
+            return step
+
+        sides = {
+            "windowed": {
+                "step": make_step(m.infer_step),
+                "state": m.initial_state(batch_size=1),
+                "window_medians": [],
+            },
+            "cached": {
+                "step": make_step(m.infer_step_cached),
+                "state": m.initial_state(batch_size=1, cached=True),
+                "window_medians": [],
+            },
+        }
+        # Warmup: the one compile per side, then step PAST roll-over so
+        # the cached side's timings are the steady post-fill regime.
+        for side in sides.values():
+            for _ in range(seq_len + 2):
+                out, side["state"] = side["step"](
+                    variables, frame, side["state"]
+                )
+            jax.block_until_ready(out["action_tokens"])
+        order = list(sides)
+        for round_i in range(rounds):
+            for name in order if round_i % 2 == 0 else order[::-1]:
+                side = sides[name]
+                times = []
+                for _ in range(window_steps):
+                    t0 = time.perf_counter()
+                    out, side["state"] = side["step"](
+                        variables, frame, side["state"]
+                    )
+                    jax.block_until_ready(out["action_tokens"])
+                    times.append((time.perf_counter() - t0) * 1000.0)
+                side["window_medians"].append(statistics.median(times))
+        row = {
+            name: {
+                "latency_p50_ms_floor": round(
+                    min(side["window_medians"]), 3
+                ),
+                "window_medians_ms": [
+                    round(x, 3) for x in side["window_medians"]
+                ],
+            }
+            for name, side in sides.items()
+        }
+        row["speedup_windowed_over_cached"] = round(
+            row["windowed"]["latency_p50_ms_floor"]
+            / row["cached"]["latency_p50_ms_floor"],
+            3,
+        )
+        per_window[str(seq_len)] = row
+
+    lo, hi = str(windows[0]), str(windows[-1])
+
+    def growth(side):
+        return round(
+            per_window[hi][side]["latency_p50_ms_floor"]
+            / per_window[lo][side]["latency_p50_ms_floor"],
+            3,
+        )
+
+    record = {
+        "metric": "serve_kvcache_cached_latency_growth",
+        "value": growth("cached"),
+        "unit": "x",
+        "windows": windows,
+        "per_window": per_window,
+        "cached_latency_growth": growth("cached"),
+        "windowed_latency_growth": growth("windowed"),
+        "model": args.model,
+        "attention_impl": args.attention_impl,
+        "dtype": args.dtype,
+        "image_hw": [args.height, args.width],
+        "rounds": rounds,
+        "window_steps": window_steps,
+        "headline": (
+            f"window {windows[0]}->{windows[-1]}: cached per-step latency "
+            f"grows {growth('cached')}x vs {growth('windowed')}x windowed "
+            "(near-flat cached = per-step device work is O(frame), not "
+            "O(window))"
+        ),
+        "timing_methodology": (
+            "interleaved windows, alternating side order per round, "
+            "best-of (floor) window median per side; cached side warmed "
+            "past window roll-over (steady shift-and-decode regime)"
+        ),
+    }
+    print(json.dumps(record), file=sys.stderr)
+    out_path = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "BENCH_serve_kvcache.json",
+    )
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(f"bench: wrote {out_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

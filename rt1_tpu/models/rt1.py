@@ -412,9 +412,18 @@ class RT1Policy(nn.Module):
 
     # ------------------------------------------------------------------ inference
 
-    def initial_state(self, batch_size: int) -> Dict[str, jnp.ndarray]:
-        """Zeroed rolling window state (reference `_state_space:105-123`)."""
-        return {
+    def initial_state(
+        self, batch_size: int, cached: bool = False
+    ) -> Dict[str, jnp.ndarray]:
+        """Zeroed rolling window state (reference `_state_space:105-123`).
+
+        ``cached=True`` adds the per-layer transformer K/V cache consumed by
+        `infer_step_cached` — one (b, layers, 2, sequence_tokens, heads,
+        key_dim) leaf at the compute dtype. Default off: the state schema
+        (and therefore every existing serving/eval program) is byte-
+        identical to the pre-cache layout.
+        """
+        state = {
             "context_image_tokens": jnp.zeros(
                 (batch_size, self.time_sequence_length, self.tokens_per_image,
                  self.token_embedding_size),
@@ -425,6 +434,13 @@ class RT1Policy(nn.Module):
             ),
             "seq_idx": jnp.zeros((), jnp.int32),
         }
+        if cached:
+            state["kv_cache"] = jnp.zeros(
+                (batch_size, self.num_layers, 2, self.sequence_tokens,
+                 self.num_heads, self.layer_size),
+                self.dtype,
+            )
+        return state
 
     def _advance_window(self, observation, state):
         """Shared inference prologue: roll-if-full, tokenize frame, insert (reference
@@ -475,6 +491,117 @@ class RT1Policy(nn.Module):
         output = {"action_tokens": tokens, "action_logits": step_logits}
         output.update(self._decode_action(tokens, step_logits))
         return output, new_state
+
+    def infer_step_cached(
+        self, observation: Dict[str, jnp.ndarray], state: Dict[str, jnp.ndarray]
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """One control step against the per-session K/V cache: tokenize the
+        incoming frame, run the transformer over ONLY its
+        `single_step_tokens` new positions, attend them against the cached
+        prefix, and roll the cache in place.
+
+        Same observation/state/output contract as `infer_step`, plus a
+        `kv_cache` state leaf (`initial_state(..., cached=True)`). While the
+        window is filling the cached prefix is position-exact, so the step
+        logits equal the full-window pass to float tolerance (pinned in
+        tests/test_rt1_cache.py). Once the window is full, each step shifts
+        the cache down by `single_step_tokens` (the ISSUE's shift layout):
+        surviving entries keep the K/V they were computed with — their
+        learned absolute position rows and their insertion-time context go
+        stale by one frame per roll — while the new frame's queries stay
+        position-exact. That staleness is the cached path's only deviation
+        from `infer_step`; `serve/parity.check_cached_parity` gates it at
+        the same ≥0.99 action-token-agreement contract as the quant gate,
+        and `PolicyEngine` bounds it by rebuilding caches (`rebuild_cache`)
+        on every invalidation event.
+        """
+        seq_idx = state["seq_idx"]
+        t_max = self.time_sequence_length
+        step = self.single_step_tokens
+        time_step = jnp.minimum(seq_idx, t_max - 1)
+
+        img_state = state["context_image_tokens"]
+        act_state = state["action_tokens"]
+        kv = state["kv_cache"]
+        full = seq_idx == t_max
+        img_state = jnp.where(full, jnp.roll(img_state, -1, axis=1), img_state)
+        act_state = jnp.where(full, jnp.roll(act_state, -1, axis=1), act_state)
+        kv = jnp.where(full, jnp.roll(kv, -step, axis=3), kv)
+
+        image = observation["image"][:, None]  # (b, 1, H, W, 3)
+        context = observation.get("natural_language_embedding")
+        new_tokens = self._tokenize_images(image, context, train=False)  # (b, 1, I, E)
+        img_state = jax.lax.dynamic_update_slice_in_dim(
+            img_state, new_tokens.astype(img_state.dtype), time_step, axis=1
+        )
+
+        # The new frame's step block: image tokens + zeroed action slots,
+        # exactly one row of `_assemble`'s layout (f32 like the stored
+        # window so the transformer's input cast matches the full pass).
+        frame = new_tokens[:, 0].astype(img_state.dtype)  # (b, I, E)
+        b = frame.shape[0]
+        step_inputs = jnp.concatenate(
+            [frame, jnp.zeros((b, self.tokens_per_action, frame.shape[-1]), frame.dtype)],
+            axis=1,
+        )  # (b, I+A, E)
+        q_start = time_step * step
+        # Decode mask = this step block's rows of the full (S, S) RT-1 mask;
+        # causal zeros past q_start+len already exclude the unwritten tail
+        # of a filling cache.
+        dec_mask = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(self._mask), q_start, step, axis=0
+        )  # (I+A, S)
+        logits, new_kv = self.transformer(
+            step_inputs,
+            attention_mask=dec_mask,
+            train=False,
+            kv_cache=kv,
+            cache_index=q_start,
+        )  # (b, I+A, vocab)
+
+        # Within the block, action logits sit one position early
+        # (the shift-by-one read, same as infer_step's `start`).
+        i0 = self.tokens_per_image - 1
+        step_logits = jax.lax.slice_in_dim(
+            logits, i0, i0 + self.tokens_per_action, axis=1
+        )  # (b, A, vocab)
+        tokens = jnp.argmax(step_logits, axis=-1).astype(jnp.int32)
+
+        act_state = jax.lax.dynamic_update_slice_in_dim(
+            act_state, tokens[:, None, :], time_step, axis=1
+        )
+        new_state = {
+            "context_image_tokens": img_state,
+            "action_tokens": act_state,
+            "seq_idx": jnp.minimum(seq_idx + 1, self.time_sequence_length),
+            "kv_cache": new_kv,
+        }
+        output = {"action_tokens": tokens, "action_logits": step_logits}
+        output.update(self._decode_action(tokens, step_logits))
+        return output, new_state
+
+    def rebuild_cache(self, state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        """Recompute every K/V cache row from the stored per-frame image
+        tokens — one full-window transformer pass, identical math to
+        `infer_step`'s `_transformer_logits`.
+
+        This is the cache invalidation primitive: after a params hot-swap
+        (or any event that makes cached K/V stale relative to the window's
+        image tokens) the serving engine runs this once per slot instead of
+        serving poisoned caches. The rebuilt rows are position-exact AND
+        context-exact for the current window, so the next cached step
+        matches the full-window pass bit-for-bit-close again.
+        """
+        seq = self._assemble(state["context_image_tokens"])  # (b, S, E)
+        mask = jnp.asarray(self._mask)  # (S, S)
+        _, new_kv = self.transformer(
+            seq,
+            attention_mask=mask,
+            train=False,
+            kv_cache=jnp.zeros_like(state["kv_cache"]),
+            cache_index=jnp.zeros((), jnp.int32),
+        )
+        return dict(state, kv_cache=new_kv)
 
     def _decode_action(self, tokens, step_logits):
         """Token→action decode shared by both inference paths

@@ -17,6 +17,19 @@ width (key_dim) and `feed_forward_size` is d_model (`transformer.py:115-117`).
 TPU-first details: attention is two einsums (MXU-shaped), the additive mask is
 prepared once outside jit, softmax in fp32 even under bf16 compute, and dropout on
 attention probabilities matches the reference's placement (`transformer.py:94-98`).
+
+Incremental decode (docs/serving.md "Incremental inference"): every module
+below also accepts ``kv_cache``/``cache_index`` kwargs. With a cache, the
+input carries only the NEW sequence positions; each attention layer projects
+their q/k/v, writes the new k/v into the cache at ``cache_index``, and
+attends the new queries against the full cached key/value prefix under a
+``(new_len, cache_len)`` mask. Position embeddings are looked up at the
+absolute positions ``cache_index + arange(new_len)``, so a cached step is
+numerically the same computation the full pass would do for those rows.
+The cache pytree is a single ``(b, layers, 2, cache_len, heads, key_dim)``
+array (k at index 0, v at index 1 of axis 2) so it can ride a serving
+engine's donated state chain as one leaf. The default (``kv_cache=None``)
+path is untouched — byte-identical to the pre-cache program.
 """
 
 from __future__ import annotations
@@ -60,6 +73,8 @@ class TFMultiHeadAttention(nn.Module):
         x: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
         train: bool = False,
+        kv_cache: Optional[jnp.ndarray] = None,
+        cache_index: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
         b, s, _ = x.shape
         h, k = self.num_heads, self.key_dim
@@ -71,6 +86,36 @@ class TFMultiHeadAttention(nn.Module):
         v = QuantDense(h * k, dtype=self.dtype, name="value")(x).reshape(b, s, h, k)
 
         import jax as _jax
+
+        if kv_cache is not None:
+            # Incremental decode: x holds only the NEW positions; write
+            # their k/v into the cache at cache_index and attend the new
+            # queries against the whole cached prefix. `mask` must be
+            # (new_len, cache_len). Same dense einsum/fp32-softmax math as
+            # the full pass (no prob dropout: decode is inference-only), so
+            # while the cache holds position-correct entries the outputs
+            # match the full pass row-for-row. Returns the updated
+            # (b, 2, cache_len, h, k) cache in place of the scores.
+            k_cache = _jax.lax.dynamic_update_slice_in_dim(
+                kv_cache[:, 0], kk, cache_index, axis=1
+            )
+            v_cache = _jax.lax.dynamic_update_slice_in_dim(
+                kv_cache[:, 1], v, cache_index, axis=1
+            )
+            logits = jnp.einsum(
+                "bqhd,bshd->bhqs", q, k_cache,
+                preferred_element_type=jnp.float32,
+            )
+            logits = logits / jnp.sqrt(jnp.asarray(k, jnp.float32))
+            if mask is not None:
+                logits = jnp.where(mask[None, None].astype(bool), logits, NEG_INF)
+            probs = nn.softmax(logits.astype(jnp.float32), axis=-1)
+            out = jnp.einsum(
+                "bhqs,bshd->bqhd", probs.astype(self.dtype), v_cache
+            )
+            out = out.reshape(b, s, h * k)
+            new_cache = jnp.stack([k_cache, v_cache], axis=1)
+            return QuantDense(self.d_model, dtype=self.dtype, name="out")(out), new_cache
 
         use_pallas = (
             self.attention_impl == "pallas"
@@ -157,8 +202,12 @@ class TransformerLayer(nn.Module):
     moe_ff_dim: Optional[int] = None  # expert hidden width; None → d_model
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False):
+    def __call__(
+        self, x, mask=None, train: bool = False, kv_cache=None, cache_index=None
+    ):
         y = nn.LayerNorm(dtype=self.dtype, name="norm_1")(x)
+        # In decode mode (kv_cache given) the second element is the layer's
+        # updated (b, 2, cache_len, h, k) cache instead of attention scores.
         attn_out, scores = TFMultiHeadAttention(
             num_heads=self.num_heads,
             key_dim=self.key_dim,
@@ -169,7 +218,7 @@ class TransformerLayer(nn.Module):
             mesh=self.mesh,
             pallas_interpret=self.pallas_interpret,
             name="attn",
-        )(y, mask=mask, train=train)
+        )(y, mask=mask, train=train, kv_cache=kv_cache, cache_index=cache_index)
         x = x + attn_out
         y = nn.LayerNorm(dtype=self.dtype, name="norm_2")(x)
         if self.ffn_impl == "moe":
@@ -215,13 +264,63 @@ class CausalTransformer(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, inputs: jnp.ndarray, attention_mask=None, train: bool = False):
-        """inputs: (b, s, input_emb) → logits (b, s, vocab_size)."""
+    def __call__(
+        self,
+        inputs: jnp.ndarray,
+        attention_mask=None,
+        train: bool = False,
+        kv_cache=None,
+        cache_index=None,
+    ):
+        """inputs: (b, s, input_emb) → logits (b, s, vocab_size).
+
+        With ``kv_cache`` (b, num_layers, 2, cache_len, heads, key_dim) and
+        a ``cache_index`` start position, `inputs` carries only the NEW
+        positions: they are embedded at absolute positions
+        ``cache_index + arange(s)``, each layer attends them against its
+        cached prefix under the (s, cache_len) ``attention_mask``, and the
+        call returns ``(logits, updated_kv_cache)``. Passing the full
+        sequence with ``cache_index=0`` and the full square mask recomputes
+        every cache row from scratch (the serving engine's invalidation
+        rebuild) — identical math to the cache-free pass.
+        """
         b, s, _ = inputs.shape
         if s > self.max_seq_len:
             raise ValueError(
                 f"sequence length {s} exceeds max_seq_len={self.max_seq_len}"
             )
+        if kv_cache is not None:
+            x = nn.Dense(self.d_model, dtype=self.dtype, name="token_emb")(inputs)
+            positions = cache_index + jnp.arange(s)
+            pos_emb = nn.Embed(
+                self.max_seq_len, self.d_model, dtype=self.dtype,
+                name="position_emb",
+            )(positions)
+            x = x + pos_emb[None, :, :]
+            new_caches = []
+            for i in range(self.num_layers):
+                x, layer_cache = TransformerLayer(
+                    key_dim=self.key_dim,
+                    num_heads=self.num_heads,
+                    d_model=self.d_model,
+                    dropout_rate=self.dropout_rate,
+                    dtype=self.dtype,
+                    # Decode always uses the dense einsum math: the
+                    # ring/pallas kernels are full-sequence (square-mask)
+                    # implementations and decode's prefix attention is a
+                    # (s × cache_len) sliver that doesn't need them.
+                    attention_impl="dense",
+                    ffn_impl=self.ffn_impl,
+                    num_experts=self.num_experts,
+                    moe_capacity_factor=self.moe_capacity_factor,
+                    moe_ff_dim=self.moe_ff_dim,
+                    name=f"layer_{i}",
+                )(x, attention_mask, False, kv_cache[:, i], cache_index)
+                new_caches.append(layer_cache)
+            logits = nn.Dense(
+                self.vocab_size, dtype=self.dtype, name="output_tokens"
+            )(x)
+            return logits, jnp.stack(new_caches, axis=1)
         if self.return_attention_scores and self.attention_impl in (
             "ring",
             "pallas",

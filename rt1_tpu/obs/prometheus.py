@@ -175,6 +175,17 @@ _SERVE_COUNTERS = {
     ),
     "capture_write_errors_total": "Episode writes that failed (kept serving).",
     "capture_pruned_total": "Old capture files pruned by the disk ring.",
+    # KV-cached incremental decode (rt1_tpu/serve/engine.py
+    # cached_inference=True): steps served from per-session caches vs
+    # full-window recomputes (cache rebuilds after hot-swap).
+    "cache_cached_steps_total": (
+        "Session steps served through the incremental KV-cache decode "
+        "path (one frame attended against cached keys)."
+    ),
+    "cache_rebuild_steps_total": (
+        "Per-session full-window cache recomputes (rebuilds after "
+        "checkpoint hot-swap invalidation)."
+    ),
 }
 
 _SERVE_HISTOGRAMS = {
@@ -230,6 +241,15 @@ _SERVE_LABELED_FAMILIES = (
         "task",
         _lexical_label_key,
         "Sessions started per client-declared task tag.",
+    ),
+    (
+        "cache_invalidations",
+        "cache_invalidations_total",
+        "counter",
+        "reason",
+        _lexical_label_key,
+        "KV-cache invalidations by cause ('swap' checkpoint hot-swap | "
+        "'reset' session reset | 'evict' LRU slot reclaim).",
     ),
 )
 
@@ -401,6 +421,22 @@ _FLEET_REPLICA_FIELDS = {
     "capture_open_sessions": (
         "gauge",
         "Capture buffers currently open on this replica.",
+    ),
+    "cache_enabled": (
+        "gauge",
+        "1 when this replica serves with per-session KV caches.",
+    ),
+    "cache_bytes_per_slot": (
+        "gauge",
+        "Device bytes of transformer K/V cache per session slot.",
+    ),
+    "cache_cached_steps_total": (
+        "counter",
+        "Steps served through incremental KV-cache decode.",
+    ),
+    "cache_rebuild_steps_total": (
+        "counter",
+        "Per-session full-window cache recomputes after invalidation.",
     ),
 }
 

@@ -118,6 +118,7 @@ def main(argv):
         max_sessions=FLAGS.max_sessions,
         buckets=buckets,
         embedder=embedder,
+        cached_inference=FLAGS.cached_inference,
     )
 
     # Standby restore source for zero-downtime hot-swap (POST /reload and
@@ -184,6 +185,7 @@ def main(argv):
                 "buckets": [int(b) for b in engine.buckets],
                 "scheduler": FLAGS.scheduler,
                 "inference_dtype": engine.inference_dtype,
+                "cached_inference": engine.cached_inference,
                 "param_bytes_device": engine.serving_param_bytes,
             }
         ),
@@ -260,6 +262,16 @@ if __name__ == "__main__":
         "EfficientNet and transformer matmul weights per-output-channel "
         "(norms/embeddings/action head stay f32). /reload requantizes "
         "standby checkpoints — compile_count stays 1.")
+    flags.DEFINE_bool(
+        "cached_inference", False,
+        "Incremental decode: keep per-session transformer K/V caches on "
+        "device so a step attends one frame against cached keys instead "
+        "of re-running the full window (rt1_tpu/serve/engine.py). Exact "
+        "while a session's window fills; after roll-over, cache entries "
+        "keep their insertion-time positions (staleness bounded at "
+        "window-1 rolls; parity gated by serve/parity.py). Hot-swap "
+        "rebuilds all caches from retained context. OFF by default — "
+        "the default path is byte-identical to the windowed engine.")
     flags.DEFINE_string(
         "embedder", "hash",
         "Instruction embedder spec (hash | ngram | use | table.npz).")

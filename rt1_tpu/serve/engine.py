@@ -145,6 +145,7 @@ class PolicyEngine:
         inference_dtype: str = "f32",
         prepare_variables: Optional[Callable[[Any], Any]] = None,
         master_variables=None,
+        cached_inference: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -205,10 +206,28 @@ class PolicyEngine:
         self._tokenizer = tokenizer
         self.embed_calls = 0  # embedder invocations (cache misses)
 
+        # Incremental inference (docs/serving.md "Incremental inference"):
+        # with cached_inference the slot state additionally holds per-layer
+        # transformer K/V caches, the compiled step is infer_step_cached
+        # (one frame's tokens attend the cached prefix instead of a full-
+        # window transformer pass), and every invalidation event (params
+        # swap) rebuilds caches via an AOT `rebuild` program. Off (the
+        # default) the state schema and the compiled program are the
+        # pre-cache ones, byte for byte.
+        self.cached_inference = bool(cached_inference)
+        self._rebuild = None  # AOT cache-rebuild executable (cached only)
+        # Invalidation bookkeeping: reset/evict zero the slot (cache gone
+        # with the window); swap rebuilds every cache from the retained
+        # image tokens under the new params.
+        self.cache_invalidations = {"swap": 0, "reset": 0, "evict": 0}
+        self.cache_cached_steps = 0   # lanes stepped through the cached program
+        self.cache_rebuild_steps = 0  # per-slot full-window cache rebuilds
+
         # Engine state: per-slot leaves stacked on a leading slot axis. The
         # model's initial_state(batch_size=1) provides per-leaf shapes/dtypes;
         # seq_idx is its only unbatched (scalar) leaf.
-        single = model.initial_state(batch_size=1)
+        single = model.initial_state(batch_size=1, cached=self.cached_inference) \
+            if self.cached_inference else model.initial_state(batch_size=1)
         self._state = jax.tree.map(
             lambda x: jnp.zeros(
                 (max_sessions,) + (x.shape[1:] if x.ndim else ()), x.dtype
@@ -304,25 +323,27 @@ class PolicyEngine:
         import jax.numpy as jnp
 
         model = self._model
+        step_method = (
+            model.infer_step_cached if self.cached_inference else model.infer_step
+        )
 
         def single_step(variables, obs, state):
-            # One lane == one batch-1 infer_step; vmap gives each lane its
+            # One lane == one batch-1 infer step; vmap gives each lane its
             # own scalar seq_idx (per-slot roll phase), which the batched
-            # state pytree cannot express directly.
+            # state pytree cannot express directly. State members are
+            # threaded by key so the cached path's kv_cache leaf rides the
+            # same (donated) chain without per-member plumbing; seq_idx is
+            # the one unbatched scalar.
             obs_b = {k: v[None] for k, v in obs.items()}
             state_b = {
-                "context_image_tokens": state["context_image_tokens"][None],
-                "action_tokens": state["action_tokens"][None],
-                "seq_idx": state["seq_idx"],
+                k: (v if k == "seq_idx" else v[None]) for k, v in state.items()
             }
             out, new_state = model.apply(
-                variables, obs_b, state_b, method=model.infer_step
+                variables, obs_b, state_b, method=step_method
             )
             out = jax.tree.map(lambda x: x[0], out)
             new_state = {
-                "context_image_tokens": new_state["context_image_tokens"][0],
-                "action_tokens": new_state["action_tokens"][0],
-                "seq_idx": new_state["seq_idx"],
+                k: (v if k == "seq_idx" else v[0]) for k, v in new_state.items()
             }
             return out, new_state
 
@@ -383,6 +404,37 @@ class PolicyEngine:
             self.compile_count += 1
         self._compiled_obs_shapes = dict(obs_shapes)
 
+        if self.cached_inference:
+            # The cache invalidation primitive, AOT-compiled alongside the
+            # ladder: recompute every slot's K/V rows from its retained
+            # per-frame image tokens (model.rebuild_cache — one full-window
+            # transformer pass per slot, no tokenizer work). One fixed
+            # shape (the whole slot batch), donated state, compiled once at
+            # the same moment as the buckets — `compile_count` stays pinned
+            # at len(buckets) and no swap ever pays an XLA compile.
+
+            def single_rebuild(variables, state):
+                state_b = {
+                    k: (v if k == "seq_idx" else v[None])
+                    for k, v in state.items()
+                }
+                new_state = model.apply(
+                    variables, state_b, method=model.rebuild_cache
+                )
+                return {
+                    k: (v if k == "seq_idx" else v[0])
+                    for k, v in new_state.items()
+                }
+
+            def rebuild_all(variables, state):
+                return jax.vmap(single_rebuild, in_axes=(None, 0))(
+                    variables, state
+                )
+
+            self._rebuild = jax.jit(rebuild_all, donate_argnums=(1,)).lower(
+                var_spec, state_spec
+            ).compile()
+
     def warmup(
         self,
         image_shape: Sequence[int],
@@ -416,6 +468,12 @@ class PolicyEngine:
     # ------------------------------------------------------------ hot-swap
 
     @property
+    def model(self):
+        """The served RT1 module (read-only — parity gates and tooling need
+        its window length / token geometry, never its apply state)."""
+        return self._model
+
+    @property
     def serving_param_bytes(self) -> int:
         """Device-resident serving-tree bytes (int8 kernels + scales count
         at their quantized size — THE memory win the quant bench records)."""
@@ -423,6 +481,18 @@ class PolicyEngine:
         return int(
             sum(leaf.nbytes for leaf in jax.tree.leaves(self._variables))
         )
+
+    @property
+    def cache_bytes_per_slot(self) -> int:
+        """Device bytes of ONE session's K/V cache rows (0 with caching
+        off) — the per-slot memory price of incremental inference that the
+        `rt1_serve_cache_slot_bytes` gauge exports."""
+        if not self.cached_inference:
+            return 0
+        kv = self._state.get("kv_cache")
+        if kv is None:
+            return 0
+        return int(kv.nbytes // self.max_sessions)
 
     @property
     def master_param_bytes(self) -> int:
@@ -542,16 +612,33 @@ class PolicyEngine:
             jax.tree.map(lambda x: x.sharding, self._variables),
         )
         jax.block_until_ready(device)  # pay the H2D cost off the swap
+        caches_rebuilt = 0
         with self._lock:
             self._variables = device
             self.reloads += 1
-        return {
+            # A params swap makes every cached K/V row stale (it was
+            # computed by the OLD transformer). Rebuild all slots' caches
+            # from their retained image tokens under the new params — the
+            # same full-window math infer_step would do — instead of
+            # serving poisoned caches. Under the lock: the rebuild must
+            # order against dispatches on the donated state chain.
+            if self.cached_inference and self._rebuild is not None:
+                self._state = self._rebuild(self._variables, self._state)
+                self.cache_invalidations["swap"] += 1
+                caches_rebuilt = len(self._sessions)
+                self.cache_rebuild_steps += caches_rebuilt
+        result = {
             "params_swapped": len(serving_flat),
             "param_bytes": int(
                 sum(np.asarray(leaf).nbytes for _, leaf in serving_flat)
             ),
             "inference_dtype": self.inference_dtype,
         }
+        if self.cached_inference:
+            # Only the cached engine reports rebuilds — the windowed swap
+            # response stays byte-identical to the pre-cache engine's.
+            result["caches_rebuilt"] = caches_rebuilt
+        return result
 
     # ------------------------------------------------------------ sessions
 
@@ -584,6 +671,11 @@ class PolicyEngine:
                 )
             slot = self._sessions.pop(victim)
             self.evictions += 1
+            if self.cached_inference:
+                # The victim's K/V rows die with its window (_zero_slot
+                # below) — booked as a cache invalidation so the scrape
+                # plane can tell churn-driven cache loss from swaps.
+                self.cache_invalidations["evict"] += 1
         self._sessions[session_id] = slot
         self._zero_slot(slot)
         return slot
@@ -599,10 +691,13 @@ class PolicyEngine:
         as /act: it must not evict a session riding a dispatched-but-
         uncollected step (retryable SlotContentionError instead)."""
         with self._lock:
+            known = session_id in self._sessions
             slot = self._slot_for(
                 session_id, protected=frozenset(self._inflight_sessions)
             )
             self._zero_slot(slot)
+            if self.cached_inference and known:
+                self.cache_invalidations["reset"] += 1
             return slot
 
     def release(self, session_id: str) -> None:
@@ -633,6 +728,92 @@ class PolicyEngine:
             return self._jax.tree.map(
                 lambda x: np.asarray(x[slot]), self._state
             )
+
+    # ------------------------------------------------------- state migration
+
+    def state_schema(self) -> List[Tuple[str, Tuple[int, ...], str]]:
+        """The per-slot network-state contract: (leaf name, per-slot shape,
+        dtype) triples, sorted by name. With cached_inference this includes
+        the `kv_cache` leaf — the cache defines the session state schema,
+        which is exactly why the migration seam lands with it."""
+        return sorted(
+            (k, tuple(v.shape[1:]), str(np.dtype(v.dtype)))
+            for k, v in self._state.items()
+        )
+
+    def export_session(self, session_id: str) -> Dict[str, Any]:
+        """Migration seam (ROADMAP item 3): gather one slot's full rolling
+        network_state — window tokens, action tokens, seq_idx, and (when
+        cached) the K/V cache rows — to host, with the schema header
+        `import_session` validates against. Pure read (no LRU refresh);
+        the snapshot is self-describing so a peer replica can refuse a
+        mismatched model before touching device memory."""
+        return {
+            "session_id": session_id,
+            "cached_inference": self.cached_inference,
+            "schema": self.state_schema(),
+            "state": self.session_state(session_id),
+        }
+
+    def import_session(self, snapshot: Dict[str, Any], session_id: Optional[str] = None) -> int:
+        """Restore an exported session into a slot of THIS engine.
+
+        Validation mirrors `swap_variables`' master-spec discipline, but
+        against the engine's state schema: leaf names, per-slot shapes and
+        dtypes must match exactly (so a windowed snapshot cannot land in a
+        cached engine and vice versa), and float leaves must be finite.
+        Raises ValueError with the first mismatch (engine untouched);
+        returns the slot on success. Caches travel verbatim — the intended
+        use is migrating a session between replicas serving the SAME
+        checkpoint (scale-down drain, re-home); after a cross-checkpoint
+        move, hot-swap semantics apply and the importer should reset or
+        rely on its own swap-time rebuild.
+        """
+        sid = session_id or snapshot.get("session_id")
+        if not sid:
+            raise SessionError("import_session: no session id in snapshot or argument")
+        state = snapshot.get("state")
+        if not isinstance(state, dict):
+            raise ValueError("import_session: snapshot has no 'state' pytree")
+        expected = self.state_schema()
+        got = sorted(
+            (k, tuple(np.asarray(v).shape), str(np.asarray(v).dtype))
+            for k, v in state.items()
+        )
+        if [k for k, _, _ in got] != [k for k, _, _ in expected]:
+            raise ValueError(
+                f"import_session: state leaves {[k for k, _, _ in got]} do "
+                f"not match this engine's schema "
+                f"{[k for k, _, _ in expected]} — cached_inference or model "
+                "mismatch between exporter and importer"
+            )
+        for (k, shape, dtype), (_, eshape, edtype) in zip(got, expected):
+            if shape != eshape or dtype != edtype:
+                raise ValueError(
+                    f"import_session: leaf {k!r} is {shape}/{dtype}, this "
+                    f"engine expects {eshape}/{edtype} — refusing a "
+                    "mismatched session snapshot"
+                )
+        bad = [
+            k
+            for k, v in state.items()
+            if np.issubdtype(np.asarray(v).dtype, np.floating)
+            and not np.isfinite(np.asarray(v)).all()
+        ]
+        if bad:
+            raise ValueError(
+                f"import_session: non-finite values in {bad} — refusing a "
+                "corrupt session snapshot"
+            )
+        with self._lock:
+            slot = self._slot_for(
+                sid, protected=frozenset(self._inflight_sessions)
+            )
+            for k, v in state.items():
+                self._state[k] = self._state[k].at[slot].set(
+                    np.asarray(v)
+                )
+            return slot
 
     # ------------------------------------------------------------ stepping
 
@@ -790,6 +971,8 @@ class PolicyEngine:
                 )
             handle.bucket = bucket
             handle.active_count = len(kept)
+            if self.cached_inference:
+                self.cache_cached_steps += len(kept)
             for _, sid, _ in kept:
                 self._inflight_sessions[sid] += 1
             self.batches_in_flight += 1

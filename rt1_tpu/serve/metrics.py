@@ -264,6 +264,12 @@ class ServeMetrics:
     # (`rt1_serve_inference_dtype{dtype="int8"} 1`). Everything else
     # stays strictly numeric (typo'd gauges must fail loudly, not vanish).
     TEXT_GAUGES = frozenset({"inference_dtype"})
+    # Snapshot keys allowed to carry a {label: count} dict — the engine's
+    # KV-cache invalidation counters ride the snapshot as a table so the
+    # Prometheus renderer can emit one labeled family
+    # (`rt1_serve_cache_invalidations_total{reason="swap"}`), matching the
+    # internal labeled families (bucket_batches, task_requests_total).
+    DICT_GAUGES = frozenset({"cache_invalidations"})
 
     @classmethod
     def _coerce_gauge(cls, name: str, value: Any):
@@ -272,6 +278,8 @@ class ServeMetrics:
         a typo'd gauge must fail the caller, not vanish from /metrics."""
         if name in cls.TEXT_GAUGES and isinstance(value, str):
             return value
+        if name in cls.DICT_GAUGES and isinstance(value, dict):
+            return {str(k): float(v) for k, v in value.items()}
         if isinstance(value, bool):
             return float(value)
         try:

@@ -1,4 +1,4 @@
-"""Canned-episode parity gate for low-precision serving engines.
+"""Canned-episode parity gates for low-precision and KV-cached engines.
 
 A quantization bug must never ship silently: before a bf16/int8 engine is
 trusted, its action-token stream is compared against the f32 engine's on a
@@ -8,7 +8,11 @@ agreement bounds the behavioral divergence of the whole closed loop.
 Tier-1 enforces the gate on the tiny config
 (tests/test_quant.py::test_int8_engine_parity_gate); the serving quant
 bench (`scripts/serve_loadgen.py --quant_ab`) reports the same statistics
-per dtype over HTTP in `BENCH_serve_quant.json`.
+per dtype over HTTP in `BENCH_serve_quant.json`. `check_cached_parity`
+applies the same machinery to KV-cached incremental decode
+(`PolicyEngine(cached_inference=True)`): the window-fill regime is gated
+at the same threshold (cached decode is exact there), while post-roll
+steady-state agreement is reported as a measured statistic.
 
 Episodes are synthetic (seeded uniform frames + one normal instruction
 embedding per episode) — the gate measures precision loss, not policy
@@ -62,6 +66,7 @@ def action_token_agreement(
     engine_ref: Any,
     engine_test: Any,
     episodes: Sequence[Sequence[Dict[str, np.ndarray]]],
+    skip_steps: int = 0,
 ) -> Dict[str, Any]:
     """Step both engines through the same observation streams and compare
     action tokens elementwise.
@@ -72,6 +77,11 @@ def action_token_agreement(
     own weights; tokens are compared per step, so a divergence that
     compounds through the window is charged to every later step it
     corrupts, not amortized away.
+
+    ``skip_steps`` excludes each episode's first N steps from the
+    statistics while still stepping both engines through them — used by
+    the KV-cache gate to measure the post-roll-over steady state in
+    isolation from the (exact) window-fill phase.
     """
     total = 0
     agree = 0
@@ -81,9 +91,11 @@ def action_token_agreement(
         sid = f"parity-{index}"
         engine_ref.reset(sid)
         engine_test.reset(sid)
-        for obs in episode:
+        for step_index, obs in enumerate(episode):
             ref = engine_ref.act(sid, dict(obs))
             test = engine_test.act(sid, dict(obs))
+            if step_index < skip_steps:
+                continue
             ref_tokens = np.asarray(ref["action_tokens"])
             test_tokens = np.asarray(test["action_tokens"])
             total += int(ref_tokens.size)
@@ -128,5 +140,67 @@ def check_parity(
             f"{stats['tokens_total']} tokens "
             f"(max action delta {stats['max_abs_action_diff']:.5f}) — "
             "refusing to trust this engine"
+        )
+    return stats
+
+
+def check_cached_parity(
+    engine_ref: Any,
+    engine_cached: Any,
+    image_shape: Sequence[int],
+    threshold: float = PARITY_THRESHOLD,
+    steady_steps: int = 5,
+    **episode_kwargs: Any,
+) -> Dict[str, Any]:
+    """Gate a KV-cached engine against the windowed reference engine.
+
+    The incremental-decode contract has two regimes and the gate measures
+    both:
+
+    * **Fill** (the enforced gate): while a session's window fills — and
+      after any cache rebuild — cached decode attends the same keys at
+      the same positions as the full-window pass, so tokens must agree
+      at >= `threshold` (they are bit-exact in practice; causal attention
+      means earlier tokens never depend on later ones). Below threshold
+      the cache plumbing is wrong and this raises ValueError.
+    * **Steady state** (the reported statistic): after roll-over, cache
+      entries keep their insertion-time learned position embeddings and
+      pre-roll context, so agreement with the windowed engine is
+      approximate (staleness structurally bounded at window-1 rolls —
+      entries leave the window after `time_sequence_length` rolls).
+      Reported as ``steady_agreement`` for deployment A/Bs, not gated:
+      it measures an accepted accuracy/latency trade, not a bug.
+
+    Episodes for the fill gate are cut at the window length so no roll
+    occurs; the steady-state measurement then runs `window + steady_steps`
+    steps and skips the fill prefix.
+    """
+    window = int(engine_cached.model.time_sequence_length)
+    fill_kwargs = dict(episode_kwargs)
+    fill_kwargs["steps"] = window
+    stats = action_token_agreement(
+        engine_ref,
+        engine_cached,
+        canned_episodes(image_shape, **fill_kwargs),
+    )
+    stats["threshold"] = threshold
+    stats["passed"] = stats["agreement"] >= threshold
+    steady_kwargs = dict(episode_kwargs)
+    steady_kwargs["steps"] = window + steady_steps
+    steady = action_token_agreement(
+        engine_ref,
+        engine_cached,
+        canned_episodes(image_shape, **steady_kwargs),
+        skip_steps=window,
+    )
+    stats["steady_agreement"] = steady["agreement"]
+    stats["steady_steps"] = steady["steps"]
+    stats["steady_max_abs_action_diff"] = steady["max_abs_action_diff"]
+    if not stats["passed"]:
+        raise ValueError(
+            f"cached-inference parity gate FAILED: fill-phase action-token "
+            f"agreement {stats['agreement']:.4f} < {threshold} over "
+            f"{stats['tokens_total']} tokens — cached decode must be exact "
+            "while the window fills; refusing to trust this engine"
         )
     return stats

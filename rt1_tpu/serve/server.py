@@ -472,6 +472,9 @@ class ServeApp:
             "inference_dtype": getattr(
                 self.engine, "inference_dtype", "f32"
             ),
+            "cached_inference": bool(
+                getattr(self.engine, "cached_inference", False)
+            ),
             # The serve hot-path contract (ISSUE 12): which scheduler
             # forms batches and which AOT bucket sizes exist —
             # compile_count is pinned at len(buckets) after warm-up.
@@ -528,6 +531,26 @@ class ServeApp:
             ),
             "param_bytes_master": getattr(
                 self.engine, "master_param_bytes", 0
+            ),
+            # Incremental-decode (KV cache) gauges: enabled flag always
+            # present so dashboards can tell "off" from "zero"; the
+            # invalidation counters split by cause (swap|reset|evict) —
+            # a swap-heavy fleet rebuilds, a churn-heavy one resets.
+            "cache_enabled": int(
+                bool(getattr(self.engine, "cached_inference", False))
+            ),
+            "cache_bytes_per_slot": getattr(
+                self.engine, "cache_bytes_per_slot", 0
+            ),
+            "cache_cached_steps_total": getattr(
+                self.engine, "cache_cached_steps", 0
+            ),
+            "cache_rebuild_steps_total": getattr(
+                self.engine, "cache_rebuild_steps", 0
+            ),
+            "cache_invalidations": dict(
+                getattr(self.engine, "cache_invalidations", {})
+                or {"swap": 0, "reset": 0, "evict": 0}
             ),
             # Flywheel capture gauges (rt1_serve_capture_*): enabled flag
             # always present so dashboards can tell "off" from "zero".

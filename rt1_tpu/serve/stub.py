@@ -68,6 +68,7 @@ class StubReplicaApp:
         buckets=None,
         scheduler: str = "continuous",
         act_concurrency: int = 0,
+        cached_inference: bool = False,
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
@@ -95,6 +96,16 @@ class StubReplicaApp:
             else None
         )
         self.reload_delay_s = reload_delay_s
+        # KV-cached incremental decode, mimicked jax-free (protocol
+        # double for the real replica's --cached_inference): the flag is
+        # advertised in /healthz + the ready-line and the cache counter
+        # families move the way the real engine moves them — acts count
+        # as cached steps, resets/reloads/slot reclaims invalidate, a
+        # reload "rebuilds" every live session's cache.
+        self.cached_inference = cached_inference
+        self.cache_invalidations = {"swap": 0, "reset": 0, "evict": 0}
+        self.cache_cached_steps = 0
+        self.cache_rebuild_steps = 0
         self.metrics = ServeMetrics()
         self.exemplars = ExemplarRing(threshold_ms=slow_threshold_ms)
         self.ready = True
@@ -171,8 +182,19 @@ class StubReplicaApp:
                     #   cost (and, gated, the queue wait for the device)
                 with self._lock:
                     started = session_id not in self._sessions
+                    if (
+                        self.cached_inference
+                        and started
+                        and len(self._sessions) >= self.max_sessions
+                    ):
+                        # Mimic the engine's LRU slot reclaim: the oldest
+                        # session's cache is invalidated for the newcomer.
+                        self._sessions.pop(next(iter(self._sessions)))
+                        self.cache_invalidations["evict"] += 1
                     step = self._sessions.get(session_id, 0)
                     self._sessions[session_id] = step + 1
+                    if self.cached_inference:
+                        self.cache_cached_steps += 1
         finally:
             if self._device_gate is not None:
                 self._device_gate.release()
@@ -205,6 +227,8 @@ class StubReplicaApp:
         if not isinstance(session_id, str) or not session_id:
             return 400, {"error": "'session_id' must be a non-empty string"}
         with self._lock:
+            if self.cached_inference and session_id in self._sessions:
+                self.cache_invalidations["reset"] += 1
             self._sessions[session_id] = 0
             slot = list(self._sessions).index(session_id)
         self.metrics.observe_reset()
@@ -230,11 +254,22 @@ class StubReplicaApp:
             self.reloads += 1
             self.checkpoint_step = payload.get("step", -1)
             self.metrics.observe_reload()
+            caches_rebuilt = 0
+            if self.cached_inference:
+                with self._lock:
+                    caches_rebuilt = len(self._sessions)
+                self.cache_invalidations["swap"] += 1
+                self.cache_rebuild_steps += caches_rebuilt
             return 200, {
                 "ok": True,
                 "checkpoint_step": self.checkpoint_step,
                 "reloads_total": self.reloads,
                 "params_swapped": 0,
+                **(
+                    {"caches_rebuilt": caches_rebuilt}
+                    if self.cached_inference
+                    else {}
+                ),
             }
         finally:
             self.reloading = False
@@ -258,6 +293,7 @@ class StubReplicaApp:
             "scheduler": self.scheduler,
             "reloads": self.reloads,
             "inference_dtype": self.inference_dtype,
+            "cached_inference": self.cached_inference,
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
@@ -286,6 +322,16 @@ class StubReplicaApp:
             # assert the per-replica gauge plumbing end to end.
             "param_bytes_device": 1000 + self.replica_id,
             "param_bytes_master": 4000,
+            # KV-cache gauge mimicry (deterministic stand-in bytes): the
+            # fleet tests assert the rt1_serve_cache_* plumbing end to
+            # end with zero jax boots.
+            "cache_enabled": int(self.cached_inference),
+            "cache_bytes_per_slot": (
+                2048 if self.cached_inference else 0
+            ),
+            "cache_cached_steps_total": self.cache_cached_steps,
+            "cache_rebuild_steps_total": self.cache_rebuild_steps,
+            "cache_invalidations": dict(self.cache_invalidations),
         }
 
     def metrics_snapshot(self) -> Dict[str, Any]:
@@ -413,6 +459,11 @@ def main(argv=None) -> int:
         "--scheduler", default="continuous",
         choices=["continuous", "cycle"],
         help="Advertised batch scheduler (protocol double only).")
+    parser.add_argument(
+        "--cached_inference", action="store_true",
+        help="Advertise KV-cached incremental decode and mimic its "
+             "counter families (protocol double for the real replica's "
+             "--cached_inference).")
     args = parser.parse_args(argv)
 
     # Bounded in-process trace ring so GET /trace (and the fleet tests'
@@ -428,6 +479,7 @@ def main(argv=None) -> int:
         buckets=[int(b) for b in args.buckets.split(",") if b.strip()],
         scheduler=args.scheduler,
         act_concurrency=args.act_concurrency,
+        cached_inference=args.cached_inference,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
     # Graceful drain on SIGTERM — the same contract the real replica's
@@ -466,6 +518,7 @@ def main(argv=None) -> int:
                 "buckets": list(app.buckets),
                 "scheduler": app.scheduler,
                 "inference_dtype": args.inference_dtype,
+                "cached_inference": app.cached_inference,
             }
         ),
         flush=True,

@@ -522,20 +522,39 @@ def run_occupancy_sweep(args) -> dict:
     occupancy AND cut p50 at low occupancy (1-2 clients, where the cycle
     path pays the max_delay deadline and the full-batch step cost), with
     `compile_count` pinned at the bucket count on both sides.
+
+    With ``--cached_ab`` (ISSUE 17) the two sides become windowed vs
+    KV-cached incremental decode on the SAME continuous scheduler — the
+    occupancy-ceiling view of `BENCH_serve_kvcache.json`: a cached step
+    does O(frame) device work instead of O(window), so the same slot
+    batch sustains more req/s at full occupancy.
     """
     levels = [
         int(x) for x in args.sweep_levels.split(",") if x.strip()
     ]
-    sides = {
-        "old_cycle": [
-            "--scheduler", "cycle",
-            "--buckets", str(args.max_sessions),
-        ],
-        "new_continuous": [
-            "--scheduler", "continuous",
-            "--buckets", "auto",
-        ],
-    }
+    if getattr(args, "cached_ab", False):
+        sides = {
+            "windowed": [
+                "--scheduler", "continuous",
+                "--buckets", "auto",
+            ],
+            "kv_cached": [
+                "--scheduler", "continuous",
+                "--buckets", "auto",
+                "--cached_inference",
+            ],
+        }
+    else:
+        sides = {
+            "old_cycle": [
+                "--scheduler", "cycle",
+                "--buckets", str(args.max_sessions),
+            ],
+            "new_continuous": [
+                "--scheduler", "continuous",
+                "--buckets", "auto",
+            ],
+        }
     # Both servers stay up for the whole sweep; passes alternate side
     # order per round (ABBA) and each (side, level) keeps its best pass —
     # the same co-tenant-CPU-theft methodology as --overhead_ab and
@@ -634,6 +653,15 @@ def run_occupancy_sweep(args) -> dict:
                     "max_batches_in_flight": metrics.get(
                         "max_batches_in_flight"
                     ),
+                    "cached_inference": bool(
+                        ready.get("cached_inference", False)
+                    ),
+                    "cache_cached_steps_total": metrics.get(
+                        "cache_cached_steps_total", 0
+                    ),
+                    "cache_bytes_per_slot": metrics.get(
+                        "cache_bytes_per_slot", 0
+                    ),
                 }
             )
     finally:
@@ -647,15 +675,20 @@ def run_occupancy_sweep(args) -> dict:
 
     full = str(args.max_sessions)
     low = str(levels[0])
-    old = per_side["old_cycle"]["levels"]
-    new = per_side["new_continuous"]["levels"]
+    baseline_name, test_name = list(sides)
+    old = per_side[baseline_name]["levels"]
+    new = per_side[test_name]["levels"]
     speedup_full = (
         new[full]["req_per_sec"] / old[full]["req_per_sec"]
         if full in new and old.get(full, {}).get("req_per_sec")
         else 0.0
     )
     return {
-        "metric": "serve_continuous_batching_speedup_full_occupancy",
+        "metric": (
+            "serve_kvcache_speedup_full_occupancy"
+            if getattr(args, "cached_ab", False)
+            else "serve_continuous_batching_speedup_full_occupancy"
+        ),
         "value": round(speedup_full, 3),
         "unit": "x",
         "levels": levels,
@@ -663,8 +696,8 @@ def run_occupancy_sweep(args) -> dict:
         "max_sessions": args.max_sessions,
         "per_side": per_side,
         "p50_low_occupancy_ms": {
-            "old_cycle": old.get(low, {}).get("latency_p50_ms"),
-            "new_continuous": new.get(low, {}).get("latency_p50_ms"),
+            baseline_name: old.get(low, {}).get("latency_p50_ms"),
+            test_name: new.get(low, {}).get("latency_p50_ms"),
         },
         "p50_speedup_low_occupancy": (
             round(
@@ -684,14 +717,22 @@ def run_occupancy_sweep(args) -> dict:
         ),
         "sweep_rounds": args.sweep_rounds,
         "timing_methodology": (
-            "one random-init replica per scheduler (identical PRNGKey(0) "
+            "one random-init replica per side (identical PRNGKey(0) "
             "weights), closed-loop clients per concurrency level, "
             "alternating ABBA passes with best-of per (side, level) — "
             "single passes are unreliable under bursty co-tenant CPU "
             "theft (same methodology as --overhead_ab); failure counts "
-            "accumulate across ALL passes. old = cycle scheduler + "
-            "single full-size bucket, new = continuous scheduler + pow2 "
-            "bucket ladder + double-buffered dispatch"
+            "accumulate across ALL passes. "
+            + (
+                "windowed = full-window infer_step, kv_cached = "
+                "per-session KV-cache incremental decode "
+                "(--cached_inference), both on the continuous scheduler "
+                "+ pow2 bucket ladder"
+                if getattr(args, "cached_ab", False)
+                else "old = cycle scheduler + single full-size bucket, "
+                "new = continuous scheduler + pow2 bucket ladder + "
+                "double-buffered dispatch"
+            )
         ),
     }
 
@@ -1516,6 +1557,12 @@ def main() -> int:
              "(--config required), drive each at every --sweep_levels "
              "concurrency, write req/s + p50/p99 per level "
              "(BENCH_serve_batching.json via --output).")
+    parser.add_argument(
+        "--cached_ab", action="store_true",
+        help="[occupancy_sweep] A/B windowed vs KV-cached incremental "
+             "decode (--cached_inference) instead of cycle-vs-continuous "
+             "— the occupancy-ceiling row of BENCH_serve_kvcache.json "
+             "(ISSUE 17). Both sides run the continuous scheduler.")
     parser.add_argument(
         "--sweep_levels", default="1,2,4,8,16",
         help="[occupancy_sweep] comma-separated concurrency levels.")

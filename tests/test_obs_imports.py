@@ -248,10 +248,27 @@ assert quant_group_for_path(
 assert quant_group_for_path(
     "params/transformer/output_tokens/kernel") == QUANT_F32
 
-from rt1_tpu.serve.parity import PARITY_THRESHOLD, canned_episodes
+from rt1_tpu.serve.parity import (
+    PARITY_THRESHOLD,
+    canned_episodes,
+    check_cached_parity,  # noqa: F401 - import-time deps only (jax-free)
+)
 
 assert PARITY_THRESHOLD >= 0.99
 assert len(canned_episodes((2, 2, 3), episodes=1, steps=2)[0]) == 2
+
+# ISSUE 17 KV-cache observability: a cached-inference stub advertises the
+# flag and its cache counter families render through the same
+# snapshot->text path (labeled invalidations ride the DICT_GAUGES seam).
+_cached_stub = StubReplicaApp(replica_id=2, cached_inference=True)
+assert _cached_stub.healthz()["cached_inference"] is True
+_cached_stub.act({"session_id": "kv", "image": []})
+_cached_stub.reset({"session_id": "kv"})
+cache_text = _cached_stub.metrics_prometheus()
+assert "# TYPE rt1_serve_cache_cached_steps_total counter" in cache_text
+assert 'rt1_serve_cache_invalidations_total{reason="reset"} 1' in cache_text
+assert "rt1_serve_cache_bytes_per_slot 2048" in cache_text
+assert "rt1_serve_replica_cache_cached_steps_total" in fleet_metric_names()
 
 # A mixed-dtype stub advertises its mode; the fleet renderer turns it
 # into the labeled info family the scrape contract names.

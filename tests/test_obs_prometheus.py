@@ -336,6 +336,9 @@ def test_fleet_metric_names_all_renderable():
     # labeled {replica_id, task}.
     full["task_requests_total"] = {"block2block": 5, "unlabeled": 1}
     full["task_sessions_total"] = {"block2block": 2}
+    # The KV-cache invalidation counters render from the reason dict
+    # (ISSUE 17), labeled {replica_id, reason}.
+    full["cache_invalidations"] = {"swap": 1, "reset": 0, "evict": 2}
     # The per-replica SLO families render from the router-attributed
     # snapshot (ISSUE 16), not the replica /metrics fan-out.
     replica_slo = {
@@ -555,6 +558,110 @@ def test_stub_counts_task_requests():
     snap = stub.metrics_snapshot()
     assert snap["task_requests_total"] == {"corner": 1, "unlabeled": 1}
     assert snap["task_sessions_total"] == {"corner": 1}
+
+
+def test_cache_families_naming_contract():
+    """ISSUE 17 naming contract: the KV-cache families render as
+    `rt1_serve_cache_*` through the one snapshot→text path — the labeled
+    invalidations dict rides the ServeMetrics DICT_GAUGES seam as
+    `rt1_serve_cache_invalidations_total{reason=}` — and the fleet
+    aggregation emits the `rt1_serve_replica_cache_*` variants the scrape
+    contract names."""
+    metrics = ServeMetrics()
+    snap = metrics.snapshot(
+        cache_enabled=1,
+        cache_bytes_per_slot=4096,
+        cache_cached_steps_total=7,
+        cache_rebuild_steps_total=2,
+        cache_invalidations={"swap": 1, "reset": 3, "evict": 0},
+    )
+    assert snap["cache_invalidations"] == {
+        "swap": 1.0, "reset": 3.0, "evict": 0.0,
+    }
+    text = prom.render_serve_snapshot(snap)
+    types, samples = parse_exposition(text)
+    assert types["rt1_serve_cache_cached_steps_total"] == "counter"
+    assert types["rt1_serve_cache_rebuild_steps_total"] == "counter"
+    assert types["rt1_serve_cache_bytes_per_slot"] == "gauge"
+    assert types["rt1_serve_cache_enabled"] == "gauge"
+    assert types["rt1_serve_cache_invalidations_total"] == "counter"
+    invalidations = {
+        labels["reason"]: value
+        for name, labels, value in samples
+        if name == "rt1_serve_cache_invalidations_total"
+    }
+    assert invalidations == {"swap": "1", "reset": "3", "evict": "0"}
+
+    # Fleet fan-out: {replica_id} (+ {reason}) double labels, and the
+    # scrape-config contract names every replica_cache_* family.
+    fleet_text = prom.render_fleet_snapshot({}, {1: snap})
+    _, fleet_samples = parse_exposition(fleet_text)
+    assert (
+        "rt1_serve_replica_cache_invalidations_total",
+        {"replica_id": "1", "reason": "reset"},
+        "3",
+    ) in fleet_samples
+    assert (
+        "rt1_serve_replica_cache_bytes_per_slot",
+        {"replica_id": "1"},
+        "4096",
+    ) in fleet_samples
+    names = prom.fleet_metric_names()
+    for family in (
+        "rt1_serve_replica_cache_enabled",
+        "rt1_serve_replica_cache_bytes_per_slot",
+        "rt1_serve_replica_cache_cached_steps_total",
+        "rt1_serve_replica_cache_rebuild_steps_total",
+        "rt1_serve_replica_cache_invalidations_total",
+    ):
+        assert family in names
+
+    # The dict seam is scoped: only DICT_GAUGES keys may carry a dict —
+    # a typo'd dict-valued gauge still fails loudly, not silently.
+    with pytest.raises(ValueError, match="cache_invalidationz"):
+        metrics.snapshot(cache_invalidationz={"swap": 1})
+
+
+def test_stub_cache_counters_mimic_engine():
+    """Satellite (ISSUE 17): the jax-free stub advertises cached_inference
+    and moves the cache counter families the way the real engine does —
+    acts are cached steps, reset/reload/slot-reclaim invalidate by reason,
+    a reload rebuilds every live session's cache — so fleet/deploy tier-1
+    tests exercise the new scrape families without a jax boot."""
+    from rt1_tpu.serve.stub import StubReplicaApp
+
+    stub = StubReplicaApp(
+        replica_id=0, max_sessions=2, cached_inference=True,
+        reload_delay_s=0.0,
+    )
+    assert stub.healthz()["cached_inference"] is True
+    for sid in ("a", "b", "c"):  # third session reclaims the oldest slot
+        code, _ = stub.act({"session_id": sid, "image": []})
+        assert code == 200
+    code, _ = stub.reset({"session_id": "b"})
+    assert code == 200
+    code, body = stub.reload({"step": 5})
+    assert code == 200
+    assert body["caches_rebuilt"] == 2  # both live sessions rebuilt
+    snap = stub.metrics_snapshot()
+    assert snap["cache_enabled"] == 1
+    assert snap["cache_cached_steps_total"] == 3
+    assert snap["cache_rebuild_steps_total"] == 2
+    assert snap["cache_invalidations"] == {
+        "swap": 1.0, "reset": 1.0, "evict": 1.0,
+    }
+
+    # Off by default: the flag advertises 0 and no counter moves, so a
+    # pre-ISSUE-17 stub fleet scrape is unchanged except cache_enabled=0.
+    plain = StubReplicaApp(replica_id=1)
+    assert plain.healthz()["cached_inference"] is False
+    plain.act({"session_id": "x", "image": []})
+    plain_snap = plain.metrics_snapshot()
+    assert plain_snap["cache_enabled"] == 0
+    assert plain_snap["cache_cached_steps_total"] == 0
+    assert plain_snap["cache_invalidations"] == {
+        "swap": 0.0, "reset": 0.0, "evict": 0.0,
+    }
 
 
 def test_cycle_scheduler_metric_parity():
