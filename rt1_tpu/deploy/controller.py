@@ -117,6 +117,13 @@ class PromotionController:
         self._canary_rid: Optional[int] = None
         self._canary_baseline = 0
         self._synthetic_breach = False  # latched for the canary episode
+        # Last judged canary/fleet burn pair, held for the scrape between
+        # ticks (0.0 while IDLE) — the `rt1_deploy_canary_burn` family
+        # the CanarySLOBreach alert watches. Includes a synthetic breach's
+        # forced burn: the alert plane must see exactly what the judge
+        # saw, or a chaos-proved rollback would be alert-invisible.
+        self._last_canary_burn = 0.0
+        self._last_fleet_burn = 0.0
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -275,6 +282,8 @@ class PromotionController:
             # bar. Client traffic stays clean — the rollback PATH is what
             # the chaos run proves.
             burn = max(burn, self.policy.burn_threshold + fleet_burn)
+        self._last_canary_burn = burn
+        self._last_fleet_burn = fleet_burn
         signals = CanarySignals(
             canary_requests=max(requests, 0),
             canary_burn=burn,
@@ -363,6 +372,8 @@ class PromotionController:
         self._canary_rid = None
         self._canary_baseline = 0
         self._synthetic_breach = False
+        self._last_canary_burn = 0.0
+        self._last_fleet_burn = 0.0
         self._judge.reset()
         self.state = IDLE
 
@@ -394,6 +405,8 @@ class PromotionController:
                     -1 if self._canary_rid is None else self._canary_rid
                 ),
                 "canary_weight": self.policy.canary_weight,
+                "canary_burn": self._last_canary_burn,
+                "fleet_burn": self._last_fleet_burn,
                 "breach_streak": self._judge.breach_streak,
                 "clean_streak": self._judge.clean_streak,
             }

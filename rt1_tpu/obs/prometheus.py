@@ -422,6 +422,14 @@ _FLEET_REPLICA_FIELDS = {
         "gauge",
         "Capture buffers currently open on this replica.",
     ),
+    "capture_write_errors_total": (
+        "counter",
+        "Episode writes that failed on this replica (kept serving).",
+    ),
+    "capture_pruned_total": (
+        "counter",
+        "Old capture files pruned by this replica's disk ring.",
+    ),
     "cache_enabled": (
         "gauge",
         "1 when this replica serves with per-session KV caches.",
@@ -662,6 +670,187 @@ def render_deploy_snapshot(
         else:
             exp.gauge(name, value)
     return exp.render()
+
+
+# ------------------------------------------------------------------ parsing
+
+
+def parse_value(text: str) -> float:
+    """Inverse of `format_value`: the three special spellings, then float."""
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)
+
+
+def _unescape(value: str) -> str:
+    """Inverse of `TextExposition._escape_label` (and the HELP escaping):
+    ``\\\\`` -> backslash, ``\\"`` -> quote, ``\\n`` -> newline. An unknown
+    escape keeps its backslash verbatim, per the exposition spec."""
+    out: List[str] = []
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                out.append(ch)
+                out.append(nxt)
+            i += 2
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(line: str, start: int) -> Tuple[Dict[str, str], int]:
+    """Parse ``{k="v",...}`` starting at the ``{``; returns (labels, index
+    just past the ``}``). Escapes inside quoted values are honoured — a
+    label value may contain braces, commas, spaces, escaped quotes."""
+    labels: Dict[str, str] = {}
+    i = start + 1
+    while i < len(line) and line[i] != "}":
+        eq = line.find("=", i)
+        if eq < 0 or eq + 1 >= len(line) or line[eq + 1] != '"':
+            raise ValueError(f"malformed labels in sample line: {line!r}")
+        key = line[i:eq].lstrip(",").strip()
+        j = eq + 2  # first char inside the quotes
+        raw: List[str] = []
+        while j < len(line):
+            ch = line[j]
+            if ch == "\\" and j + 1 < len(line):
+                raw.append(line[j : j + 2])
+                j += 2
+                continue
+            if ch == '"':
+                break
+            raw.append(ch)
+            j += 1
+        if j >= len(line) or line[j] != '"':
+            raise ValueError(f"unterminated label value: {line!r}")
+        labels[key] = _unescape("".join(raw))
+        i = j + 1
+        if i < len(line) and line[i] == ",":
+            i += 1
+    if i >= len(line) or line[i] != "}":
+        raise ValueError(f"unterminated label set: {line!r}")
+    return labels, i + 1
+
+
+class Exposition:
+    """A parsed text exposition: {family: type}, {family: help}, and the
+    flat (name, labels, value) sample list. What `parse_exposition`
+    returns; the collector iterates `samples`, the round-trip tests
+    compare values against the source snapshot."""
+
+    def __init__(
+        self,
+        types: Dict[str, str],
+        help_texts: Dict[str, str],
+        samples: List[Tuple[str, Dict[str, str], float]],
+    ):
+        self.types = types
+        self.help = help_texts
+        self.samples = samples
+
+    def value(self, name: str, **labels: str) -> float:
+        """The single sample with exactly these labels; KeyError if absent
+        (or ambiguous — duplicates indicate a renderer bug)."""
+        hits = [
+            v for n, lb, v in self.samples if n == name and lb == labels
+        ]
+        if len(hits) != 1:
+            raise KeyError(
+                f"{name}{labels}: {len(hits)} matching samples"
+            )
+        return hits[0]
+
+    def labeled(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        return [(lb, v) for n, lb, v in self.samples if n == name]
+
+    def histogram(self, family: str) -> Dict[str, Any]:
+        """Reassemble one histogram family back into the snapshot shape:
+        cumulative ``buckets`` as (le, count) pairs with le in JSON form
+        (float, or "+Inf" for the overflow — matching
+        `ServeMetrics._bucket_json`), plus ``sum`` and ``count``."""
+        if self.types.get(family) != "histogram":
+            raise KeyError(f"{family!r} is not a parsed histogram family")
+        buckets: List[Tuple[Any, int]] = []
+        for labels, value in self.labeled(family + "_bucket"):
+            le = labels.get("le", "")
+            buckets.append(
+                (le if le == "+Inf" else float(le), int(value))
+            )
+        return {
+            "buckets": buckets,
+            "sum": self.value(family + "_sum"),
+            "count": int(self.value(family + "_count")),
+        }
+
+
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_exposition(text: str) -> Exposition:
+    """Parse Prometheus text exposition 0.0.4 — the provable inverse of
+    `TextExposition.render` (and so of every ``render_*`` in this module).
+
+    Strict by design: a sample before its ``# TYPE`` header, a duplicate
+    family header, an unknown comment, or an unparsable value raises
+    ``ValueError``. If the renderer ever drifts from the format the
+    collector ingests, the round-trip tests fail loudly instead of the
+    history silently dropping families.
+    """
+    types: Dict[str, str] = {}
+    help_texts: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP ") :]
+            name, _, help_text = rest.partition(" ")
+            help_texts[name] = _unescape(help_text)
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4:
+                raise ValueError(f"malformed TYPE line: {line!r}")
+            _, _, name, mtype = parts
+            if name in types:
+                raise ValueError(f"duplicate family header: {name!r}")
+            types[name] = mtype
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"unknown comment line: {line!r}")
+        # Sample: name[{labels}] value — label values may contain spaces.
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace >= 0 and (space < 0 or brace < space):
+            name = line[:brace]
+            labels, end = _parse_labels(line, brace)
+            value_text = line[end:].strip()
+        else:
+            name, _, value_text = line.partition(" ")
+            labels = {}
+        base = name
+        for suffix in _HISTOGRAM_SUFFIXES:
+            stripped = name[: -len(suffix)] if name.endswith(suffix) else ""
+            if stripped and types.get(stripped) == "histogram":
+                base = stripped
+        if base not in types:
+            raise ValueError(f"sample {name!r} precedes its # TYPE header")
+        samples.append((name, labels, parse_value(value_text.strip())))
+    return Exposition(types, help_texts, samples)
 
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -27,6 +27,14 @@ Definitions (classic SRE error-budget arithmetic):
   run spent its budget exactly, >1 means burning faster than allowed.
 * rolling variants over the last ``window`` requests, so a long healthy
   run does not hide a current incident.
+* time-windowed variants (``windowed_burn`` / ``windowed_availability``)
+  over the last ``window_s`` SECONDS. The request-indexed rolling view
+  freezes at its peak when traffic stops — after a shed burst with no
+  follow-on requests, nothing ages the bad outcomes out of the deque,
+  which is exactly the pathology the PR 15 autoscaler had to patch with
+  an activity gate. The time-windowed view decays on the wall clock
+  instead: a quiet minute after an incident reads as burn -> 0, not
+  burn-frozen-at-peak. The clock is injectable for tests.
 
 Latency objectives are judged on *answered* requests (ok + restarted):
 a shed request has no meaningful latency, and a fleet must not be able
@@ -101,13 +109,40 @@ class SLOLedger:
     for the post-mortem artifact.
     """
 
-    def __init__(self, objectives: Optional[SLOObjectives] = None):
+    #: Retention cap for the timestamped outcome deque: the widest window
+    #: `windowed_burn` can be asked about. 15 minutes covers every
+    #: fast/slow multi-burn-rate pair the alert plane ships by default.
+    MAX_WINDOW_S = 900.0
+
+    def __init__(
+        self,
+        objectives: Optional[SLOObjectives] = None,
+        clock=None,
+        max_window_s: Optional[float] = None,
+    ):
+        import time as _time
+
         self.objectives = objectives or SLOObjectives()
+        self._clock = clock if clock is not None else _time.monotonic
+        self.max_window_s = float(
+            max_window_s if max_window_s is not None else self.MAX_WINDOW_S
+        )
+        if self.max_window_s <= 0:
+            raise ValueError(
+                f"max_window_s must be positive, got {self.max_window_s}"
+            )
         self._lock = threading.Lock()
         self._counts = {k: 0 for k in OUTCOMES}
         # Rolling good/bad flags (1 = ok) for the burn-rate window.
         self._rolling_good: Deque[int] = collections.deque(
             maxlen=self.objectives.window
+        )
+        # Timestamped (t, good) outcomes for the TIME-windowed burn view.
+        # Evicted by age (> max_window_s) on observe and on read, and by
+        # point count as a backstop, so a traffic spike cannot grow the
+        # deque without bound.
+        self._timed_good: Deque[tuple] = collections.deque(
+            maxlen=max(self.objectives.window * 8, 4096)
         )
         # Bounded per-class latency reservoirs (most recent `window`
         # samples): percentiles over the recent past, not a week-old mix.
@@ -123,10 +158,18 @@ class SLOLedger:
             raise ValueError(
                 f"unknown outcome {outcome!r}; expected one of {OUTCOMES}"
             )
+        now = self._clock()
         with self._lock:
             self._counts[outcome] += 1
             self._rolling_good.append(1 if outcome == "ok" else 0)
+            self._timed_good.append((now, 1 if outcome == "ok" else 0))
+            self._evict_timed_locked(now)
             self._latencies[outcome].append(float(latency_s))
+
+    def _evict_timed_locked(self, now: float) -> None:
+        cutoff = now - self.max_window_s
+        while self._timed_good and self._timed_good[0][0] < cutoff:
+            self._timed_good.popleft()
 
     # ------------------------------------------------------------ reporting
 
@@ -137,6 +180,51 @@ class SLOLedger:
     def _answered_sorted(self) -> list:
         return sorted(
             list(self._latencies["ok"]) + list(self._latencies["restarted"])
+        )
+
+    # ------------------------------------------------- time-windowed view
+
+    def windowed_counts(
+        self, window_s: float, now: Optional[float] = None
+    ) -> Dict[str, int]:
+        """{"total": n, "good": n} over the trailing `window_s` seconds."""
+        if window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {window_s}")
+        if now is None:
+            now = self._clock()
+        cutoff = now - min(window_s, self.max_window_s)
+        with self._lock:
+            self._evict_timed_locked(now)
+            total = good = 0
+            for t, g in reversed(self._timed_good):
+                if t < cutoff:
+                    break
+                total += 1
+                good += g
+        return {"total": total, "good": good}
+
+    def windowed_availability(
+        self, window_s: float, now: Optional[float] = None
+    ) -> float:
+        """ok-fraction over the trailing `window_s` seconds; 1.0 when the
+        window holds no requests (no traffic spends no budget)."""
+        counts = self.windowed_counts(window_s, now=now)
+        if not counts["total"]:
+            return 1.0
+        return counts["good"] / counts["total"]
+
+    def windowed_burn(
+        self, window_s: float, now: Optional[float] = None
+    ) -> float:
+        """Error-budget burn over the trailing `window_s` SECONDS — the
+        signal the autoscaler and the multi-window burn alerts consume.
+        Unlike ``slo_error_budget_burn_rolling`` (request-indexed), this
+        decays on the wall clock: a post-incident quiet period ages the
+        bad outcomes out of the window and the burn falls back to 0
+        instead of freezing at its peak."""
+        return self._burn(
+            self.windowed_availability(window_s, now=now),
+            self.objectives.error_budget,
         )
 
     def gauges(self) -> Dict[str, float]:

@@ -13,9 +13,13 @@ decision function over router-observed signals —
   per slot (the router-side analogue of replica queue depth);
 * **shed pressure** — admission-control rejections since the last tick
   (a router that is 429ing is a router that wants more capacity);
-* **SLO burn** — the ledger's rolling error-budget burn rate
-  (`rt1_tpu/obs/slo.py`): latency/availability degradation is a scale-up
-  signal even before occupancy saturates.
+* **SLO burn** — the ledger's TIME-windowed error-budget burn
+  (`SLOLedger.windowed_burn`, `rt1_tpu/obs/slo.py`): availability
+  degradation is a scale-up signal even before occupancy saturates. The
+  window is `burn_window_s` of wall clock, not a request count, so a
+  post-incident quiet period decays the signal by itself — the old
+  request-indexed rolling burn froze at its peak with no follow-on
+  traffic, which is why pressure used to be activity-gated.
 
 Decisions are hysteretic and asymmetric by design: scale **up fast**
 (`up_sustain_ticks` consecutive pressure ticks, short cooldown — a spike
@@ -63,9 +67,13 @@ class AutoscalePolicy:
     down_sustain_ticks: int = 6
     up_cooldown_ticks: int = 2
     down_cooldown_ticks: int = 4
-    # Rolling error-budget burn at/above this is scale-up pressure even at
-    # low occupancy (slow replicas, not just full ones). 0 disables.
+    # Time-windowed error-budget burn at/above this is scale-up pressure
+    # even at low occupancy (slow replicas, not just full ones). 0
+    # disables.
     burn_pressure: float = 2.0
+    # Wall-clock window (seconds) the burn signal is computed over — the
+    # supervisor passes this to `SLOLedger.windowed_burn` each tick.
+    burn_window_s: float = 60.0
     # Window (seconds) a session counts as active after its last act —
     # consumed by the router's occupancy signal, carried here so the
     # whole policy travels as one object.
@@ -101,7 +109,10 @@ class FleetSignals:
     session_slots: int  # replicas_ready * per-replica max_sessions
     inflight: int = 0  # requests mid-route through the router right now
     shed_delta: int = 0  # OVERLOAD admission sheds since the previous tick
-    rolling_burn: float = 0.0  # SLO ledger rolling error-budget burn
+    # SLO error-budget burn over the policy's `burn_window_s` of wall
+    # clock (`SLOLedger.windowed_burn`) — decays on its own when traffic
+    # stops, unlike the request-indexed rolling gauge.
+    rolling_burn: float = 0.0
     # Replicas spawned but never yet ready (state STARTING) — the
     # one-boot-at-a-time gate keys on THIS, not on total != ready: a
     # replica that is alive but persistently 503 (wedged warmup, failed
@@ -158,19 +169,15 @@ class Autoscaler:
             )
         if s.shed_delta > 0:
             return f"admission shed {s.shed_delta} request(s) last tick"
-        if (
-            p.burn_pressure > 0
-            and s.active_sessions > 0
-            and s.rolling_burn >= p.burn_pressure
-        ):
-            # Burn counts as pressure only while traffic is live: the
-            # rolling window is request-indexed, so after a shed/restart
-            # burst with no follow-on traffic the burn FREEZES at its
-            # peak — without the activity gate that frozen reading would
-            # pin the fleet at max forever (no new requests ever arrive
-            # to dilute it).
+        if p.burn_pressure > 0 and s.rolling_burn >= p.burn_pressure:
+            # No activity gate: the burn signal is time-windowed
+            # (`SLOLedger.windowed_burn`), so a shed/restart burst with no
+            # follow-on traffic ages out of the window by itself — the
+            # frozen-at-peak pathology the old request-indexed gauge had
+            # (and the `active_sessions > 0` guard existed to patch) is
+            # gone at the source.
             return (
-                f"rolling SLO burn {s.rolling_burn:.2f} >= "
+                f"windowed SLO burn {s.rolling_burn:.2f} >= "
                 f"{p.burn_pressure:.2f}"
             )
         return None

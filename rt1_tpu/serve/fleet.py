@@ -41,6 +41,18 @@ stub with `--stub`), fronts them with the session-affine `Router`
   `DTYPE_COST_WEIGHTS` it becomes the cost-per-request column of
   `BENCH_serve_elastic.json`.
 
+* **Metrics plane** (`--collector`, ISSUE 18). An in-process collector
+  scrapes the fleet's own `/metrics` fan-out (and `/deploy/status` when
+  promotion is armed) into a bounded ring TSDB every
+  `--collector_interval_s`, evaluates the default alert ruleset
+  (multi-window SLO burn, replica loss, compile drift, flap/storm
+  detectors) after each cycle, and lights up `/alerts`, `/history` and
+  `/dashboard` on the router port. Firing alerts land in the same
+  flight-recorder stream as the slow-request exemplars; on shutdown the
+  TSDB snapshots into `<obs_dir>/tsdb_snapshot.jsonl` for the
+  run-report post-mortem. Unarmed, every surface is byte-identical to
+  the pre-collector fleet.
+
 The supervisor owns processes, the router owns routing state; they meet at
 the shared `Replica` objects. `scripts/serve_loadgen.py --fleet N` drives
 this module as a subprocess and turns the chaos run into
@@ -50,6 +62,7 @@ A/B into `BENCH_serve_elastic.json`.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import signal
@@ -148,6 +161,14 @@ class FleetSupervisor:
         # (fleet main's final status line, while the scraper still runs).
         self._exemplar_lock = threading.Lock()
         self.last_exemplars: Dict[int, Dict[str, Any]] = {}
+        # Firing/resolving alerts ride the same flight-recorder stream:
+        # the AlertManager's callbacks land transitions here (collector
+        # thread), so "what was alerting when the fleet died" survives
+        # into the final status line even if /alerts was never scraped.
+        # deque(maxlen) keeps appends atomic and the log bounded.
+        self.alert_events: "collections.deque" = collections.deque(
+            maxlen=256
+        )
         # Data flywheel: each replica captures episodes into
         # <capture_root>/replica_<id>; the scrape loop sweeps completed
         # files into <capture_root>/staging — ONE dir the packer appends
@@ -466,6 +487,11 @@ class FleetSupervisor:
                     body["generation"] = replica.restarts
                     self.last_exemplars[replica.id] = body
 
+    def note_alert(self, event: Dict[str, Any]) -> None:
+        """AlertManager on_fire/on_resolve hook — alert transitions into
+        the fleet's crash-surviving evidence stream."""
+        self.alert_events.append(dict(event))
+
     def replica_capture_dir(self, replica_id: int) -> Optional[str]:
         if self.capture_root is None:
             return None
@@ -543,9 +569,15 @@ class FleetSupervisor:
             session_slots=ready * self.max_sessions,
             inflight=self.router.inflight,
             shed_delta=shed_delta,
-            rolling_burn=self.router.slo.gauges()[
-                "slo_error_budget_burn_rolling"
-            ],
+            # Time-windowed burn (ISSUE 18), not the request-indexed
+            # rolling gauge: with no follow-on traffic the window ages
+            # out and the burn decays to zero on the wall clock, so a
+            # shed/restart burst can't pin scale-up pressure forever.
+            rolling_burn=self.router.slo.windowed_burn(
+                self.autoscale_policy.burn_window_s
+                if self.autoscale_policy
+                else 60.0
+            ),
             replicas_booting=sum(1 for r in live if r.state == STARTING),
         )
 
@@ -1059,6 +1091,23 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--slo_p99_ms", type=float, default=2500.0,
         help="Router SLO: answered-request p99 objective (ms).")
+    # Metrics plane (ISSUE 18): default off keeps surfaces byte-identical.
+    parser.add_argument(
+        "--collector", action="store_true",
+        help="Arm the metrics plane: an in-process collector scrapes "
+             "this fleet's own /metrics (and /deploy/status when "
+             "promotion is armed) into a ring TSDB, evaluates the "
+             "default alert ruleset each cycle, and serves /alerts, "
+             "/history and /dashboard on the router port.")
+    parser.add_argument(
+        "--collector_interval_s", type=float, default=2.0,
+        help="Scrape cadence — which is also the alert-evaluation "
+             "cadence, like a Prometheus rule group.")
+    parser.add_argument(
+        "--obs_dir", default="",
+        help="Where the armed collector writes tsdb_snapshot.jsonl on "
+             "shutdown for the run_report.py post-mortem (default: "
+             "--workdir when set; neither set = no snapshot).")
     parser.add_argument(
         "--promote_from", default="",
         help="Continuous deployment (rt1_tpu/deploy): watch this train "
@@ -1256,6 +1305,91 @@ def main(argv=None) -> int:
         router, host=args.host, port=args.port, quiet=not args.verbose
     )
 
+    tsdb = None
+    alert_manager = None
+    collector = None
+    if args.collector:
+        from rt1_tpu.obs.alerts import AlertManager, default_ruleset
+        from rt1_tpu.obs.collector import Collector, Target
+        from rt1_tpu.obs.dashboard import render_dashboard_html
+        from rt1_tpu.obs.tsdb import SNAPSHOT_BASENAME, TSDB
+
+        tsdb = TSDB()
+        alert_manager = AlertManager(
+            tsdb,
+            default_ruleset(),
+            on_fire=supervisor.note_alert,
+            on_resolve=supervisor.note_alert,
+        )
+        # The collector scrapes the fleet's OWN router port — the same
+        # exposition text any external Prometheus would see, so the
+        # history it stores can never disagree with the live scrape.
+        router_url = (
+            f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+        )
+        obs_targets = [Target("fleet", router_url + "/metrics")]
+        if controller is not None:
+            obs_targets.append(
+                Target(
+                    "deploy",
+                    router_url + "/deploy/status",
+                    kind="json",
+                    prefix="rt1_deploy_status",
+                )
+            )
+        collector = Collector(
+            tsdb,
+            obs_targets,
+            interval_s=args.collector_interval_s,
+            alert_manager=alert_manager,
+        )
+
+        def _history(params: Dict[str, str]) -> Dict[str, Any]:
+            # /history: no family = the series listing; family= one
+            # family's windowed points across every label instance.
+            # KeyError/ValueError propagate into the router's 400.
+            window_s = float(params.get("window_s", 900.0))
+            family = params.get("family", "")
+            if not family:
+                return {
+                    "window_s": window_s,
+                    "series": tsdb.series_index(),
+                    "stats": tsdb.stats(),
+                }
+            series = [
+                {
+                    "family": family,
+                    "labels": labels,
+                    "points": tsdb.points(
+                        family, labels=labels or None, window_s=window_s
+                    ),
+                }
+                for labels in tsdb.instances(family)
+            ]
+            if not series:
+                raise KeyError(family)
+            return {
+                "window_s": window_s, "family": family, "series": series,
+            }
+
+        router.alerts_status_fn = alert_manager.status
+        router.history_fn = _history
+        router.obs_metrics_text_fn = lambda: (
+            alert_manager.prometheus_text() + collector.prometheus_text()
+        )
+        router.dashboard_html_fn = lambda: render_dashboard_html(
+            tsdb,
+            alert_manager=alert_manager,
+            collector=collector,
+            fleet_status=router.fleet_status(probe_metrics=False),
+            deploy_status=(
+                controller.deploy_gauges()
+                if controller is not None
+                else None
+            ),
+        )
+        collector.start()
+
     stop_once = threading.Event()
 
     def _shutdown(signum, frame):  # noqa: ARG001 - signal signature
@@ -1286,6 +1420,7 @@ def main(argv=None) -> int:
                     else None
                 ),
                 "admission": admission is not None,
+                "collector": bool(args.collector),
                 "deploy": (
                     {
                         "promote_from": args.promote_from,
@@ -1308,6 +1443,16 @@ def main(argv=None) -> int:
             # Stop deciding BEFORE the drain flips: a promote/rollback
             # racing the shutdown would reload replicas mid-teardown.
             controller.stop()
+        if collector is not None:
+            # Stop scraping before teardown: a cycle racing the drain
+            # would count shutdown 503s as target failures, and the
+            # snapshot should capture the incident, not the funeral.
+            collector.stop()
+            obs_dir = args.obs_dir or args.workdir
+            if obs_dir:
+                tsdb.write_snapshot(
+                    os.path.join(obs_dir, SNAPSHOT_BASENAME)
+                )
         router.draining = True
         final = {
             "status": "stopped",
@@ -1328,6 +1473,19 @@ def main(argv=None) -> int:
             # run-report consume.
             "deploy": (
                 controller.summary() if controller is not None else None
+            ),
+            # Metrics-plane evidence (None unless --collector): final
+            # alert state + full transition history, per-target scrape
+            # bookkeeping, and the TSDB's own bounds counters.
+            "obs": (
+                {
+                    "alerts": alert_manager.status(),
+                    "alert_events": list(supervisor.alert_events),
+                    "collector": collector.stats(),
+                    "tsdb": tsdb.stats(),
+                }
+                if collector is not None
+                else None
             ),
         }
         supervisor.stop()

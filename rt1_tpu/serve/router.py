@@ -357,6 +357,18 @@ class Router:
         # controller.
         self.deploy_gauges_fn: Optional[Callable[[], Dict[str, Any]]] = None
         self.deploy_status_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        # Observability seam (ISSUE 18): fleet main points these at the
+        # collector/TSDB/AlertManager when --collector is armed. Same
+        # contract as the deploy seam — all None keeps every surface
+        # (/alerts, /history, /dashboard, the appended rt1_alert_* /
+        # rt1_obs_collector_* scrape families) absent and the unarmed
+        # router byte-identical.
+        self.alerts_status_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        self.history_fn: Optional[
+            Callable[[Dict[str, str]], Dict[str, Any]]
+        ] = None
+        self.dashboard_html_fn: Optional[Callable[[], str]] = None
+        self.obs_metrics_text_fn: Optional[Callable[[], str]] = None
 
     # ------------------------------------------------------------ registry
 
@@ -1021,6 +1033,10 @@ class Router:
             text += obs_prometheus.render_deploy_snapshot(
                 self.deploy_gauges_fn()
             )
+        if self.obs_metrics_text_fn is not None:
+            # rt1_alert_* + rt1_obs_collector_* families when the metrics
+            # plane is armed: the ops scrape carries its own health.
+            text += self.obs_metrics_text_fn()
         return text
 
     def fleet_slow_requests(self) -> Dict[str, Any]:
@@ -1115,6 +1131,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 - stdlib casing
+        if self.path.startswith("/history"):
+            # /history[?family=...&window_s=...] — TSDB read-out (armed
+            # fleets only; the query string selects one series window).
+            if self.router.history_fn is None:
+                self._reply(404, {"error": "no metrics collector armed"})
+                return
+            from urllib.parse import parse_qs, urlparse
+
+            query = parse_qs(urlparse(self.path).query)
+            params = {k: v[-1] for k, v in query.items()}
+            try:
+                self._reply(200, self.router.history_fn(params))
+            except (KeyError, ValueError) as exc:
+                self._reply(400, {"error": str(exc)})
+            return
         if self.path == "/healthz":
             self._reply(200, self.router.healthz())
         elif self.path == "/readyz":
@@ -1131,6 +1162,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": "no promotion controller armed"})
             else:
                 self._reply(200, self.router.deploy_status_fn())
+        elif self.path == "/alerts":
+            if self.router.alerts_status_fn is None:
+                self._reply(404, {"error": "no metrics collector armed"})
+            else:
+                self._reply(200, self.router.alerts_status_fn())
+        elif self.path == "/dashboard":
+            if self.router.dashboard_html_fn is None:
+                self._reply(404, {"error": "no metrics collector armed"})
+            else:
+                self._reply_text(
+                    200,
+                    self.router.dashboard_html_fn(),
+                    "text/html; charset=utf-8",
+                )
         elif self.path == "/metrics":
             # ONE scrape target for the whole fleet: the router's own
             # families plus every replica's curated fields, fanned out on

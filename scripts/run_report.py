@@ -176,6 +176,24 @@ def load_deploy(workdir: str) -> Optional[Dict[str, Any]]:
         return None  # half-written record from a killed cycle
 
 
+def load_obs(workdir: str) -> Optional[Dict[str, Any]]:
+    """The metrics plane's shutdown snapshot (``tsdb_snapshot.jsonl``,
+    written by `fleet --collector` or `scripts/obs_collector.py`), or
+    None for a training-only workdir. Torn final lines are tolerated by
+    the snapshot reader — a SIGKILLed collector still reports."""
+    from rt1_tpu.obs import tsdb as tsdb_mod
+
+    path = os.path.join(workdir, tsdb_mod.SNAPSHOT_BASENAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        record = tsdb_mod.read_snapshot(path)
+    except OSError:
+        return None
+    record["_path"] = path
+    return record
+
+
 def load_eval_matrix(workdir: str) -> Optional[Dict[str, Any]]:
     """The task × checkpoint eval-matrix record (scripts/eval_matrix.py),
     or None when the workdir has never run a sweep."""
@@ -565,6 +583,150 @@ def render_deploy(record: Optional[Dict[str, Any]]) -> List[str]:
     return lines
 
 
+#: The families whose history earns a sparkline in the post-mortem — the
+#: incident-shaped signals, in the order an on-call reads them.
+_OBS_SPARK_FAMILIES = (
+    "rt1_serve_slo_error_budget_burn_rolling",
+    "rt1_serve_slo_requests_total",
+    "rt1_serve_replica_up",
+    "rt1_serve_active_sessions",
+    "rt1_deploy_canary_burn",
+    "rt1_deploy_status_rollbacks_total",
+)
+
+
+def render_obs(record: Optional[Dict[str, Any]]) -> List[str]:
+    """The alerts-and-history section: what the metrics plane remembered.
+
+    Reconstructed purely from the TSDB snapshot — the ``rt1_alert_*``
+    families the collector scraped back off its own router are the alert
+    timeline (an instance's series spans exactly the cycles it was
+    active), and the key serve/deploy families render as sparklines."""
+    from rt1_tpu.obs.dashboard import spark_line
+
+    lines = ["## Alerts & history (metrics plane)", ""]
+    if record is None:
+        lines.append(
+            "tsdb_snapshot.jsonl not found — no collector was armed "
+            "(fleet --collector / scripts/obs_collector.py)."
+        )
+        return lines
+    header = record.get("header") or {}
+    series = record.get("series") or []
+    lines.append(
+        f"Snapshot {record.get('_path', '?')}: "
+        f"{header.get('series', len(series))} series, "
+        f"{header.get('points', '?')} points "
+        f"(retention {header.get('retention_s', '?')} s)."
+    )
+
+    # Alert timeline: every rt1_alert_firing/pending instance with the
+    # span of scrape cycles it was active for.
+    alert_rows = []
+    for row in series:
+        family = row.get("family", "")
+        if family not in ("rt1_alert_firing", "rt1_alert_pending"):
+            continue
+        labels = row.get("labels") or {}
+        points = row.get("points") or []
+        if not points:
+            continue
+        alert_rows.append(
+            (
+                labels.get("alert", "?"),
+                labels.get("severity", "?"),
+                family.rsplit("_", 1)[-1],
+                points[0][0],
+                points[-1][0],
+                {
+                    k: v
+                    for k, v in labels.items()
+                    if k not in ("alert", "severity")
+                },
+            )
+        )
+    counters = {
+        row["family"]: row["points"][-1][1]
+        for row in series
+        if row.get("family", "").startswith("rt1_alert_")
+        and row.get("family", "").endswith("_total")
+        and row.get("points")
+    }
+    lines.append("")
+    if alert_rows:
+        fired = counters.get("rt1_alert_fired_total")
+        resolved = counters.get("rt1_alert_resolved_total")
+        suffix = (
+            f" (fired_total={fired:.0f}, resolved_total={resolved:.0f})"
+            if fired is not None and resolved is not None
+            else ""
+        )
+        lines.append(f"Alert timeline{suffix}:")
+        for name, severity, state, t0, t1, extra in sorted(
+            alert_rows, key=lambda r: (r[3], r[0])
+        ):
+            extra_text = (
+                " " + " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+                if extra
+                else ""
+            )
+            lines.append(
+                f"  [{severity:>4}] {name:<22} {state:<7} "
+                f"seen {t1 - t0:6.1f}s{extra_text}"
+            )
+    elif counters:
+        lines.append(
+            "No alert instance was active at any scrape "
+            f"(fired_total={counters.get('rt1_alert_fired_total', 0):.0f})."
+        )
+    else:
+        lines.append(
+            "No rt1_alert_* families in the snapshot — no scraped target "
+            "exposed alert state (a fleet scrapes its own rt1_alert_* "
+            "families back only when --collector is armed in-process)."
+        )
+
+    # Key-signal sparklines, newest right — the at-a-glance shape of the
+    # incident (or of its absence).
+    sparks = []
+    for row in series:
+        if row.get("family") not in _OBS_SPARK_FAMILIES:
+            continue
+        points = row.get("points") or []
+        if not points:
+            continue
+        labels = row.get("labels") or {}
+        label_text = (
+            "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            + "}"
+            if labels
+            else ""
+        )
+        sparks.append(
+            (
+                _OBS_SPARK_FAMILIES.index(row["family"]),
+                f"  {row['family'] + label_text:<52} "
+                f"{spark_line([v for _, v in points], width=32):<32} "
+                f"{points[-1][1]:g}",
+            )
+        )
+    if sparks:
+        lines.append("")
+        lines.append("Key signals (sparkline, newest right -> last value):")
+        lines.extend(text for _, text in sorted(sparks))
+    shown = {row["family"] for row in series} & set(_OBS_SPARK_FAMILIES)
+    other = len(series) - sum(
+        1 for row in series if row.get("family") in shown
+    )
+    if other > 0:
+        lines.append("")
+        lines.append(
+            f"...plus {other} more stored series (scripts/obs_console.py "
+            f"--snapshot {record.get('_path', '?')} browses them all)."
+        )
+    return lines
+
+
 def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     """The serve post-mortem: SLO verdict, per-class outcome table,
     fleet/chaos evidence from the BENCH record, slowest exemplars."""
@@ -804,6 +966,7 @@ def render_report(
     eval_matrix: Optional[Dict[str, Any]] = None,
     multichip: Optional[Dict[str, Any]] = None,
     deploy: Optional[Dict[str, Any]] = None,
+    obs: Optional[Dict[str, Any]] = None,
 ) -> str:
     sections = [
         [f"# RT-1 run report — {workdir}", ""],
@@ -830,6 +993,11 @@ def render_report(
     if serve is not None:
         sections.insert(1, [""])
         sections.insert(1, render_serve(serve, tail=tail))
+    if obs is not None:
+        # Above the serve post-mortem: the alert timeline is the index
+        # into the SLO story below it.
+        sections.insert(1, [""])
+        sections.insert(1, render_obs(obs))
     if deploy is not None:
         # Ahead of the serve post-mortem: what the fleet is serving (and
         # how it got there) frames the SLO story below it.
@@ -861,6 +1029,7 @@ def main(argv=None):
         eval_matrix=load_eval_matrix(args.workdir),
         multichip=load_multichip(args.workdir, args.multichip),
         deploy=load_deploy(args.workdir),
+        obs=load_obs(args.workdir),
     )
     if args.out:
         with open(args.out, "w") as f:

@@ -383,6 +383,52 @@ router16.set_canary(0, 0.5)
 assert router16.canary_status()["weight"] == 0.5
 assert router16.clear_canary() == 0
 
+# ISSUE 18 metrics plane: the TSDB, collector, alert engine, and both
+# dashboard skins all live inside the model-free router/supervisor
+# process (and the standalone obs scripts) — stdlib + obs only, and the
+# whole loop (scrape -> store -> evaluate -> render) must work under the
+# blocker.
+from rt1_tpu.obs.alerts import AlertManager, default_ruleset
+from rt1_tpu.obs.collector import Collector, Target
+from rt1_tpu.obs.dashboard import render_console, render_dashboard_html
+from rt1_tpu.obs.prometheus import parse_exposition
+from rt1_tpu.obs.tsdb import TSDB
+
+_clock18 = {"t": 1000.0}
+tsdb18 = TSDB(clock=lambda: _clock18["t"])
+mgr18 = AlertManager(
+    tsdb18, default_ruleset(), clock=lambda: _clock18["t"])
+assert len(mgr18.status()["rules"]) >= 9
+col18 = Collector(
+    tsdb18,
+    [Target("probe", "http://unused/metrics")],
+    alert_manager=mgr18,
+    clock=lambda: _clock18["t"],
+    fetch_fn=lambda url, timeout_s: (
+        "# TYPE rt1_serve_replica_up gauge\n"
+        'rt1_serve_replica_up{replica_id="0"} 0\n'
+    ),
+)
+_clock18["t"] += 120.0
+assert col18.scrape_once()["probe"] == 1
+assert mgr18.active() and mgr18.active()[0]["alert"] == "ReplicaDown"
+assert tsdb18.query("rt1_serve_replica_up", "latest", 60.0,
+                    labels={"replica_id": "0"}) == 0.0
+rt18 = parse_exposition(col18.prometheus_text())
+assert rt18.value("rt1_obs_collector_up", target="probe") == 1.0
+assert "ReplicaDown" in render_console(tsdb18, alert_manager=mgr18)
+assert "<html>" in render_dashboard_html(tsdb18, alert_manager=mgr18,
+                                         collector=col18)
+
+# The time-windowed SLO burn (satellite of ISSUE 18) is part of the same
+# stdlib-only ledger the router scrapes.
+_sclock18 = {"t": 0.0}
+sled18 = obs.SLOLedger(clock=lambda: _sclock18["t"])
+sled18.observe("failed", 1.0)
+assert sled18.windowed_burn(60.0) > 0
+_sclock18["t"] += 120.0
+assert sled18.windowed_burn(60.0) == 0.0
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

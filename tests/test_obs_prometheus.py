@@ -1025,3 +1025,102 @@ def test_deploy_families_naming_contract():
     parse_exposition(combined)
     assert "rt1_deploy_state" in combined
     assert router.fleet_metrics_snapshot()["deploy"]["state"] == "idle"
+
+
+# ------------------------------------------- library parser round-trip
+
+
+def test_parse_exposition_is_inverse_of_renderer():
+    """ISSUE 18: `prom.parse_exposition` (what the collector ingests)
+    must reassemble EXACTLY what the renderer emitted — every
+    naming-contract family, labeled families, histogram +Inf buckets —
+    pinned against a maximally-populated fleet render."""
+    full = {key: 2.0 for key in prom._FLEET_REPLICA_FIELDS}
+    full["inference_dtype"] = "int8"
+    full["bucket_batches"] = {"1": 3, "4": 2}
+    full["bucket_occupancy_sum"] = {"1": 3, "4": 7}
+    full["task_requests_total"] = {"block2block": 5, "unlabeled": 1}
+    full["task_sessions_total"] = {"block2block": 2}
+    full["cache_invalidations"] = {"swap": 1, "reset": 0, "evict": 2}
+    replica_slo = {
+        0: {
+            "outcomes": {"ok": 5, "restarted": 1, "rejected": 0, "failed": 0},
+            "requests_total": 6,
+            "availability_rolling": 5 / 6,
+            "error_budget_burn_rolling": (1 / 6) / 0.01,
+        }
+    }
+    text = prom.render_fleet_snapshot({}, {0: full}, replica_slo=replica_slo)
+    parsed = prom.parse_exposition(text)
+
+    # Every family the scrape-config contract promises is parsed back
+    # with a type, and every promised name was exercised by this render.
+    for name in prom.fleet_metric_names():
+        assert name in parsed.types, f"{name} lost in parse"
+
+    # Values round-trip numerically per (name, labels) key.
+    # `up` renders clamped to 0/1 regardless of the raw field value.
+    assert parsed.value("rt1_serve_replica_up", replica_id="0") == 1.0
+    assert parsed.value(
+        "rt1_serve_replica_queue_depth", replica_id="0"
+    ) == 2.0
+    assert parsed.value(
+        "rt1_serve_replica_task_requests_total",
+        replica_id="0", task="block2block",
+    ) == 5.0
+    assert parsed.value(
+        "rt1_serve_replica_cache_invalidations_total",
+        replica_id="0", reason="evict",
+    ) == 2.0
+    assert parsed.value(
+        "rt1_serve_replica_slo_availability_rolling", replica_id="0"
+    ) == pytest.approx(5 / 6)
+
+    # And the parse is total: the local structural checker and the
+    # library parser agree on the sample count (no silent drops).
+    _, raw_samples = parse_exposition(text)
+    assert len(parsed.samples) == len(raw_samples)
+
+
+def test_parse_exposition_histogram_reassembles_inf_bucket():
+    metrics = ServeMetrics()
+    for v in (0.003, 0.02, 0.02, 9.0):
+        metrics.observe_request(v)
+    snap = metrics.snapshot(active_sessions=0, compile_count=0)
+    parsed = prom.parse_exposition(prom.render_serve_snapshot(snap))
+    hist = parsed.histogram("rt1_serve_request_latency_seconds")
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(snap["latency_sum_s"])
+    # Cumulative and capped by the overflow bucket, le in JSON form.
+    les = [le for le, _ in hist["buckets"]]
+    counts = [c for _, c in hist["buckets"]]
+    assert les[-1] == "+Inf"
+    assert counts[-1] == 4
+    assert counts == sorted(counts)
+    # Histogram suffix samples need no separate TYPE header...
+    assert "rt1_serve_request_latency_seconds_bucket" not in parsed.types
+
+
+def test_parse_exposition_is_strict():
+    with pytest.raises(ValueError):
+        prom.parse_exposition("rt1_orphan 1\n")  # sample before TYPE
+    with pytest.raises(ValueError):
+        prom.parse_exposition(
+            "# TYPE g gauge\n# TYPE g gauge\ng 1\n"
+        )  # duplicate family header
+    with pytest.raises(ValueError):
+        prom.parse_exposition("# WAT g\n")  # unknown comment
+    with pytest.raises(ValueError):
+        prom.parse_exposition("# TYPE g gauge\ng one\n")  # bad value
+    # Label values with spaces/escapes survive the round trip.
+    exp = prom.TextExposition()
+    exp.family(
+        "rt1_info",
+        "gauge",
+        [({"msg": 'a "quoted" back\\slash value'}, 1.0)],
+        help_text="escape test",
+    )
+    parsed = prom.parse_exposition(exp.render())
+    assert parsed.labeled("rt1_info") == [
+        ({"msg": 'a "quoted" back\\slash value'}, 1.0)
+    ]

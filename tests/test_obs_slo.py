@@ -326,3 +326,75 @@ def test_slo_summary_is_json_serializable():
         ledger.observe(outcome, 0.01)
     json.dumps(ledger.summary())
     json.dumps(ledger.gauges())
+
+
+# ------------------------------------------------------- windowed burn
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def test_windowed_burn_decays_on_wall_clock():
+    """ISSUE 18 regression: the time-windowed burn must fall back to 0
+    after a quiet period — the exact case where the request-indexed
+    rolling gauge freezes at its incident peak (why the autoscaler used
+    to need an activity gate)."""
+    clock = _Clock()
+    ledger = SLOLedger(SLOObjectives(availability=0.99), clock=clock)
+    for _ in range(10):
+        ledger.observe("failed")
+    assert ledger.windowed_burn(60.0) == pytest.approx(100.0)
+    # Both views agree mid-incident.
+    assert ledger.gauges()["slo_error_budget_burn_rolling"] == pytest.approx(
+        100.0
+    )
+    # 2 minutes of silence: no traffic at all.
+    clock.t += 120.0
+    assert ledger.windowed_burn(60.0) == 0.0
+    assert ledger.windowed_availability(60.0) == 1.0  # no traffic, no spend
+    # ...while the rolling request-indexed view stays frozen at peak.
+    assert ledger.gauges()["slo_error_budget_burn_rolling"] == pytest.approx(
+        100.0
+    )
+
+
+def test_windowed_counts_respect_window_and_clamp():
+    clock = _Clock()
+    ledger = SLOLedger(
+        SLOObjectives(availability=0.99), clock=clock, max_window_s=300.0
+    )
+    ledger.observe("failed")
+    clock.t += 100.0
+    ledger.observe("ok")
+    ledger.observe("ok")
+    assert ledger.windowed_counts(60.0) == {"total": 2, "good": 2}
+    assert ledger.windowed_counts(200.0) == {"total": 3, "good": 2}
+    assert ledger.windowed_burn(60.0) == 0.0
+    assert ledger.windowed_burn(200.0) == pytest.approx((1 / 3) / 0.01)
+    # A window wider than the retention cap clamps to the cap: outcomes
+    # older than max_window_s were already evicted.
+    clock.t += 250.0  # the "failed" is now 350s old, past the 300s cap
+    assert ledger.windowed_counts(10_000.0) == {"total": 2, "good": 2}
+    assert ledger.windowed_burn(10_000.0) == 0.0
+    with pytest.raises(ValueError):
+        ledger.windowed_counts(0.0)
+
+
+def test_windowed_burn_mixed_traffic_dilutes_and_recovers():
+    clock = _Clock()
+    ledger = SLOLedger(SLOObjectives(availability=0.99), clock=clock)
+    # 50% failures inside the window -> burn 50x the 1% budget.
+    for i in range(20):
+        ledger.observe("failed" if i % 2 else "ok")
+    assert ledger.windowed_burn(60.0) == pytest.approx(50.0)
+    # Clean follow-on traffic in a LATER window: old failures age out,
+    # the fresh window is healthy.
+    clock.t += 90.0
+    for _ in range(10):
+        ledger.observe("ok")
+    assert ledger.windowed_burn(60.0) == 0.0

@@ -1,0 +1,641 @@
+"""The metrics plane's memory (ISSUE 18): `obs/tsdb.py` ring semantics,
+`obs/alerts.py` lifecycle, and `obs/collector.py` scrape bookkeeping —
+all under injected fake clocks and fetchers, no sockets, no sleeps.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from rt1_tpu.obs.alerts import (
+    AlertManager,
+    AlertRule,
+    default_ruleset,
+    threshold_condition,
+)
+from rt1_tpu.obs.collector import Collector, Target, flatten_json
+from rt1_tpu.obs.prometheus import parse_exposition
+from rt1_tpu.obs.tsdb import SNAPSHOT_BASENAME, TSDB, read_snapshot
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+        return self.t
+
+
+# ------------------------------------------------------------------ TSDB
+
+
+def test_tsdb_point_cap_ring_overwrite():
+    clock = FakeClock()
+    db = TSDB(max_points=4, clock=clock)
+    for i in range(10):
+        db.append("f", float(i), t=clock.advance(1.0))
+    pts = db.points("f")
+    assert [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+    assert db.points_evicted_total == 6
+
+
+def test_tsdb_time_retention_applies_on_write_and_read():
+    clock = FakeClock()
+    db = TSDB(retention_s=10.0, clock=clock)
+    db.append("f", 1.0, t=clock.t)
+    clock.advance(5.0)
+    db.append("f", 2.0, t=clock.t)
+    assert len(db.points("f")) == 2
+    # A quiet series must not serve stale samples: retention is enforced
+    # at read time too, without any further append.
+    clock.advance(20.0)
+    assert db.points("f") == []
+    assert db.latest("f") is None
+
+
+def test_tsdb_max_series_evicts_quietest_not_oldest():
+    clock = FakeClock()
+    db = TSDB(max_series=2, clock=clock)
+    db.append("a", 1.0)
+    db.append("b", 1.0)
+    db.append("a", 2.0)  # "a" re-appended: "b" is now the quietest
+    db.append("c", 1.0)  # cap hit -> "b" dropped
+    assert db.families() == ["a", "c"]
+    assert db.series_dropped_total == 1
+
+
+def test_tsdb_labels_key_series_independently():
+    db = TSDB(clock=FakeClock())
+    db.append("up", 1.0, labels={"replica_id": "0"})
+    db.append("up", 0.0, labels={"replica_id": "1"})
+    assert db.instances("up") == [
+        {"replica_id": "0"},
+        {"replica_id": "1"},
+    ]
+    assert db.latest("up", labels={"replica_id": "1"})[1] == 0.0
+    index = {
+        (row["family"], tuple(sorted(row["labels"].items())))
+        for row in db.series_index()
+    }
+    assert ("up", (("replica_id", "0"),)) in index
+
+
+def test_tsdb_query_aggregates_with_fake_clock_windows():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    for i, v in enumerate([1.0, 3.0, 2.0, 10.0]):
+        db.append("g", v, t=1000.0 + 10.0 * i)
+    clock.t = 1030.0
+    q = lambda agg, w, **kw: db.query("g", agg, w, **kw)  # noqa: E731
+    assert q("latest", 100.0) == 10.0
+    assert q("avg", 100.0) == 4.0
+    assert q("min", 100.0) == 1.0
+    assert q("max", 100.0) == 10.0
+    assert q("sum", 100.0) == 16.0
+    assert q("count", 100.0) == 4.0
+    assert q("delta", 100.0) == 9.0
+    assert q("quantile", 100.0, q=0.5) == 3.0  # nearest-rank, upper
+    # Window restriction: only the last two points (t=1020, 1030).
+    assert q("avg", 15.0) == 6.0
+    # Empty window -> None; unknown agg -> ValueError.
+    assert q("avg", 10.0, now=1000.0 + 3600.0) is None
+    with pytest.raises(ValueError):
+        q("p99", 100.0)
+
+
+def test_tsdb_increase_tolerates_counter_reset():
+    clock = FakeClock(t=1040.0)
+    db = TSDB(clock=clock)
+    for i, v in enumerate([10.0, 15.0, 2.0, 7.0]):  # restart at i=2
+        db.append("c_total", v, t=1000.0 + 10.0 * i)
+    # Sum of positive steps only: 5 + 0 + 5; delta would say -3.
+    assert db.query("c_total", "increase", 100.0) == 10.0
+    assert db.query("c_total", "rate", 100.0) == pytest.approx(10.0 / 30.0)
+    assert db.query("c_total", "delta", 100.0) == -3.0
+    # Change aggregates need two points to say anything.
+    db.append("single", 5.0, t=1040.0)
+    assert db.query("single", "increase", 100.0) is None
+
+
+def test_tsdb_append_many_shares_one_timestamp():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    n = db.append_many(
+        [("a", None, 1.0), ("b", {"x": "1"}, 2.0)], t=1234.0
+    )
+    assert n == 2
+    assert db.points("a")[0][0] == 1234.0
+    assert db.points("b", labels={"x": "1"})[0][0] == 1234.0
+
+
+def test_tsdb_concurrent_append_and_query():
+    db = TSDB(max_points=256)
+    errors = []
+
+    def writer(wid):
+        try:
+            for i in range(300):
+                db.append("w", float(i), labels={"writer": str(wid)})
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    def reader():
+        try:
+            for _ in range(200):
+                db.query("w", "latest", 3600.0, labels={"writer": "0"})
+                db.series_index()
+                db.stats()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert db.appends_total == 4 * 300
+
+
+def test_tsdb_snapshot_round_trip(tmp_path):
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    db.append("a", 1.5, t=1000.0)
+    db.append("a", 2.5, t=1001.0)
+    db.append("b", 7.0, labels={"k": "v"}, t=1000.0)
+    path = db.write_snapshot(str(tmp_path / SNAPSHOT_BASENAME))
+    loaded = read_snapshot(path)
+    assert loaded["header"]["series"] == 2
+    assert loaded["header"]["points"] == 3
+
+    db2 = TSDB(clock=FakeClock())
+    assert db2.restore(path) == 3
+    assert [v for _, v in db2.points("a")] == [1.5, 2.5]
+    assert db2.latest("b", labels={"k": "v"}) == (1000.0, 7.0)
+
+
+def test_tsdb_snapshot_tolerates_torn_final_line(tmp_path):
+    db = TSDB(clock=FakeClock())
+    db.append("a", 1.0, t=1000.0)
+    db.append("b", 2.0, t=1000.0)
+    path = db.write_snapshot(str(tmp_path / SNAPSHOT_BASENAME))
+    body = open(path).read().rstrip("\n")
+    torn = str(tmp_path / "torn.jsonl")
+    with open(torn, "w") as f:
+        f.write(body[: len(body) - 10])  # hard kill mid-line
+    loaded = read_snapshot(torn)
+    # The torn line ends the parse; everything before it survives.
+    assert [row["family"] for row in loaded["series"]] == ["a"]
+    db2 = TSDB(clock=FakeClock())
+    assert db2.restore(torn) == 1
+
+
+def test_tsdb_snapshot_write_is_atomic(tmp_path):
+    db = TSDB(clock=FakeClock())
+    db.append("a", 1.0, t=1000.0)
+    path = str(tmp_path / "snap" / SNAPSHOT_BASENAME)
+    db.write_snapshot(path)  # creates the parent dir
+    db.append("a", 2.0, t=1001.0)
+    db.write_snapshot(path)  # os.replace over the old file
+    assert not os.path.exists(path + ".tmp")
+    assert read_snapshot(path)["header"]["points"] == 2
+
+
+# ---------------------------------------------------------------- alerts
+
+
+def _rule(for_duration_s=0.0, threshold=5.0, **kw):
+    return AlertRule(
+        name=kw.pop("name", "HighG"),
+        condition=threshold_condition(
+            "g", op=">=", threshold=threshold, agg="latest", window_s=60.0
+        ),
+        for_duration_s=for_duration_s,
+        **kw,
+    )
+
+
+def test_alert_for_duration_gates_pending_to_firing():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, [_rule(for_duration_s=10.0)], clock=clock)
+
+    db.append("g", 9.0, t=clock.t)
+    assert mgr.evaluate() == []  # pending, not firing
+    assert mgr.active()[0]["state"] == "pending"
+
+    clock.advance(5.0)
+    db.append("g", 9.0, t=clock.t)
+    assert mgr.evaluate() == []  # still inside for_duration_s
+
+    clock.advance(5.0)
+    db.append("g", 9.0, t=clock.t)
+    events = mgr.evaluate()
+    assert [e["event"] for e in events] == ["firing"]
+    assert mgr.active()[0]["state"] == "firing"
+    assert mgr.counters()["fired_total"] == 1
+
+
+def test_alert_zero_for_duration_fires_same_pass():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, [_rule()], clock=clock)
+    db.append("g", 9.0, t=clock.t)
+    assert [e["event"] for e in mgr.evaluate()] == ["firing"]
+
+
+def test_alert_cleared_pending_rearms_silently():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, [_rule(for_duration_s=10.0)], clock=clock)
+    db.append("g", 9.0, t=clock.t)
+    mgr.evaluate()  # pending
+    db.append("g", 1.0, t=clock.advance(1.0))
+    assert mgr.evaluate() == []  # dropped without a resolved event
+    assert mgr.active() == []
+    assert mgr.history() == []
+    # Re-breach restarts the pending timer from scratch.
+    db.append("g", 9.0, t=clock.advance(1.0))
+    mgr.evaluate()
+    clock.advance(9.0)
+    db.append("g", 9.0, t=clock.t)
+    assert mgr.evaluate() == []  # 9s < 10s: must re-earn the duration
+    clock.advance(1.0)
+    db.append("g", 9.0, t=clock.t)
+    assert [e["event"] for e in mgr.evaluate()] == ["firing"]
+
+
+def test_alert_resolve_emits_event_with_duration():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    fired, resolved = [], []
+    mgr = AlertManager(
+        db,
+        [_rule()],
+        clock=clock,
+        on_fire=fired.append,
+        on_resolve=resolved.append,
+    )
+    db.append("g", 9.0, t=clock.t)
+    mgr.evaluate()
+    db.append("g", 1.0, t=clock.advance(30.0))
+    events = mgr.evaluate()
+    assert [e["event"] for e in events] == ["resolved"]
+    assert events[0]["duration_s"] == 30.0
+    assert len(fired) == 1 and len(resolved) == 1
+    assert [e["event"] for e in mgr.history()] == ["firing", "resolved"]
+    # Resolved instance must re-earn: a fresh breach fires again.
+    db.append("g", 9.0, t=clock.advance(1.0))
+    assert [e["event"] for e in mgr.evaluate()] == ["firing"]
+    assert mgr.counters()["fired_total"] == 2
+
+
+def test_alert_rule_error_freezes_instances():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    blow_up = {"on": False}
+
+    def cond(tsdb, now):
+        if blow_up["on"]:
+            raise RuntimeError("scrape database on fire")
+        pts = tsdb.points("g", window_s=60.0, now=now)
+        return [({}, pts[-1][1])] if pts and pts[-1][1] >= 5.0 else []
+
+    mgr = AlertManager(db, [AlertRule("X", cond)], clock=clock)
+    db.append("g", 9.0, t=clock.t)
+    mgr.evaluate()
+    assert mgr.active()[0]["state"] == "firing"
+    # Broken rule: the firing instance must NOT silently resolve.
+    blow_up["on"] = True
+    assert mgr.evaluate() == []
+    assert mgr.active()[0]["state"] == "firing"
+    assert mgr.counters()["rule_errors_total"] == 1
+
+
+def test_alert_callback_errors_are_swallowed():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+
+    def bad_cb(event):
+        raise RuntimeError("pager webhook down")
+
+    mgr = AlertManager(db, [_rule()], clock=clock, on_fire=bad_cb)
+    db.append("g", 9.0, t=clock.t)
+    events = mgr.evaluate()  # must not raise
+    assert [e["event"] for e in events] == ["firing"]
+    assert mgr.counters()["callback_errors_total"] == 1
+
+
+def test_alert_per_instance_fanout_and_prometheus_text():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, [_rule(severity="page")], clock=clock)
+    db.append("g", 9.0, labels={"replica_id": "0"}, t=clock.t)
+    db.append("g", 1.0, labels={"replica_id": "1"}, t=clock.t)
+    mgr.evaluate()
+    active = mgr.active()
+    assert len(active) == 1
+    assert active[0]["labels"] == {"replica_id": "0"}
+    parsed = parse_exposition(mgr.prometheus_text())
+    samples = {
+        (name, tuple(sorted(labels.items()))): value
+        for name, labels, value in parsed.samples
+    }
+    assert (
+        samples[
+            (
+                "rt1_alert_firing",
+                (
+                    ("alert", "HighG"),
+                    ("replica_id", "0"),
+                    ("severity", "page"),
+                ),
+            )
+        ]
+        == 1.0
+    )
+    assert samples[("rt1_alert_fired_total", ())] == 1.0
+
+
+def test_alert_duplicate_rule_names_rejected():
+    db = TSDB(clock=FakeClock())
+    with pytest.raises(ValueError):
+        AlertManager(db, [_rule(), _rule()])
+    with pytest.raises(ValueError):
+        AlertRule("bad", lambda tsdb, now: [], severity="sev1")
+    with pytest.raises(ValueError):
+        AlertRule("bad", lambda tsdb, now: [], for_duration_s=-1.0)
+
+
+def test_default_ruleset_names_are_the_ops_contract():
+    names = {r.name for r in default_ruleset()}
+    assert {
+        "SLOBurnRateFast",
+        "SLOBurnRateSlow",
+        "ReplicaDown",
+        "CanarySLOBreach",
+        "CompileCountDrift",
+        "FeederStall",
+        "AutoscalerFlapping",
+        "CacheRebuildStorm",
+        "CaptureDiskPressure",
+    } <= names
+
+
+def test_default_ruleset_is_quiet_on_empty_tsdb():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, default_ruleset(), clock=clock)
+    assert mgr.evaluate() == []
+    assert mgr.active() == []
+    assert mgr.counters()["rule_errors_total"] == 0
+
+
+def test_slo_burn_alerts_from_counter_deltas():
+    """Multi-window multi-burn-rate over scraped counters: only an error
+    rate above threshold x budget in BOTH windows pages."""
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, default_ruleset(), clock=clock)
+    total, ok = 0, 0
+    # 10 minutes of clean traffic, then 60s of 50% failures.
+    for _ in range(600):
+        total, ok = total + 1, ok + 1
+        db.append("rt1_serve_slo_requests_total", total, t=clock.t)
+        db.append("rt1_serve_slo_requests_ok", ok, t=clock.advance(1.0))
+    assert mgr.evaluate() == []
+    for i in range(60):
+        total += 1
+        ok += i % 2
+        db.append("rt1_serve_slo_requests_total", total, t=clock.t)
+        db.append("rt1_serve_slo_requests_ok", ok, t=clock.advance(1.0))
+    fired = {e["alert"] for e in mgr.evaluate() if e["event"] == "firing"}
+    assert "SLOBurnRateFast" in fired  # 50% errors >> 8x the 1% budget
+    # Clean again: the 60s window clears first, the fast page resolves.
+    for _ in range(300):
+        total, ok = total + 1, ok + 1
+        db.append("rt1_serve_slo_requests_total", total, t=clock.t)
+        db.append("rt1_serve_slo_requests_ok", ok, t=clock.advance(1.0))
+        mgr.evaluate()
+    assert "SLOBurnRateFast" not in {a["alert"] for a in mgr.active()}
+
+
+# -------------------------------------------------------------- collector
+
+
+def test_collector_ingests_and_books_per_target():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    bodies = {
+        "http://a/metrics": (
+            "# TYPE rt1_serve_replica_up gauge\n"
+            'rt1_serve_replica_up{replica_id="0"} 1\n'
+        ),
+        "http://b/deploy/status": json.dumps(
+            {"phase": "idle", "rollbacks_total": 2, "canary": {"armed": True}}
+        ),
+    }
+    coll = Collector(
+        db,
+        [
+            Target("fleet", "http://a/metrics"),
+            Target(
+                "deploy",
+                "http://b/deploy/status",
+                kind="json",
+                prefix="rt1_deploy_status",
+            ),
+        ],
+        clock=clock,
+        fetch_fn=lambda url, timeout_s: bodies[url],
+    )
+    ingested = coll.scrape_once()
+    assert ingested == {"fleet": 1, "deploy": 2}  # strings are skipped
+    # One shared timestamp across every family in the cycle.
+    t_up = db.latest("rt1_serve_replica_up", {"replica_id": "0"})[0]
+    assert db.latest("rt1_deploy_status_rollbacks_total")[0] == t_up
+    assert db.latest("rt1_deploy_status_canary_armed")[1] == 1.0
+    stats = coll.stats()["targets"]
+    assert stats["fleet"]["up"] == 1.0
+    assert stats["deploy"]["samples_ingested_total"] == 2.0
+
+
+def test_collector_failed_target_is_counted_not_fatal():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+
+    def fetch(url, timeout_s):
+        if "dead" in url:
+            raise OSError("connection refused")
+        return "# TYPE g gauge\ng 1\n"
+
+    coll = Collector(
+        db,
+        [Target("live", "http://live/metrics"),
+         Target("dead", "http://dead/metrics")],
+        clock=clock,
+        fetch_fn=fetch,
+    )
+    ingested = coll.scrape_once()
+    assert ingested == {"live": 1, "dead": -1}
+    stats = coll.stats()["targets"]
+    assert stats["dead"]["up"] == 0.0
+    assert stats["dead"]["scrape_errors_total"] == 1.0
+    assert stats["live"]["up"] == 1.0
+    # The live target's samples landed despite the dead sibling.
+    assert db.latest("g")[1] == 1.0
+    parsed = parse_exposition(coll.prometheus_text())
+    samples = {
+        (name, tuple(sorted(labels.items()))): value
+        for name, labels, value in parsed.samples
+    }
+    assert samples[("rt1_obs_collector_up", (("target", "dead"),))] == 0.0
+    assert samples[("rt1_obs_collector_cycles_total", ())] == 1.0
+
+
+def test_collector_scrape_cadence_is_alert_cadence():
+    clock = FakeClock()
+    db = TSDB(clock=clock)
+    mgr = AlertManager(db, default_ruleset(), clock=clock)
+    coll = Collector(
+        db,
+        [Target("fleet", "http://a/metrics")],
+        clock=clock,
+        fetch_fn=lambda url, timeout_s: (
+            "# TYPE rt1_serve_replica_up gauge\n"
+            'rt1_serve_replica_up{replica_id="1"} 0\n'
+        ),
+        alert_manager=mgr,
+    )
+    coll.scrape_once()
+    active = {a["alert"]: a for a in mgr.active()}
+    assert active["ReplicaDown"]["state"] == "firing"
+    assert active["ReplicaDown"]["labels"]["replica_id"] == "1"
+
+
+def test_collector_rejects_bad_config():
+    db = TSDB(clock=FakeClock())
+    with pytest.raises(ValueError):
+        Collector(db, [Target("a", "u"), Target("a", "u2")])
+    with pytest.raises(ValueError):
+        Collector(db, [Target("a", "u")], interval_s=0.0)
+    with pytest.raises(ValueError):
+        Target("a", "u", kind="xml")
+
+
+def test_flatten_json_nested_bools_and_skips():
+    samples = flatten_json(
+        {
+            "a": {"b": 1, "c": True},
+            "d": 2.5,
+            "skip_str": "READY",
+            "skip_list": [1, 2],
+        },
+        "p",
+    )
+    assert sorted(samples) == [
+        ("p_a_b", None, 1.0),
+        ("p_a_c", None, 1.0),
+        ("p_d", None, 2.5),
+    ]
+
+
+# ------------------------------------------- stub-fleet integration
+
+
+def test_collector_over_stub_fleet_with_capture_mimicry():
+    """ISSUE 18 satellite: the whole plane against an in-process stub
+    fleet — capture-mimicking stub gauges ride the fan-out as
+    rt1_serve_replica_capture_* families, the collector ingests the ONE
+    aggregated scrape, ReplicaDown fires when a replica goes dark and
+    resolves when it comes back. Zero jax, zero subprocesses."""
+    import threading
+
+    from rt1_tpu.serve.router import READY, Replica, Router
+    from rt1_tpu.serve.stub import StubReplicaApp, make_stub_server
+
+    router = Router(replica_timeout_s=5.0)
+    servers = []
+    try:
+        for rid in range(2):
+            app = StubReplicaApp(replica_id=rid, mimic_capture=True)
+            httpd = make_stub_server(app)
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+            host, port = httpd.server_address[:2]
+            replica = router.add_replica(
+                Replica(rid, url=f"http://{host}:{port}")
+            )
+            replica.state = READY
+            servers.append(httpd)
+
+        router.route_act({"session_id": "s0", "image_b64": "AAAA"})
+
+        clock = FakeClock()
+        db = TSDB(clock=clock)
+        mgr = AlertManager(db, default_ruleset(), clock=clock)
+        coll = Collector(
+            db,
+            [Target("fleet", "ignored://the-fetch-is-in-process")],
+            clock=clock,
+            fetch_fn=lambda url, t: router.fleet_metrics_prometheus(),
+            alert_manager=mgr,
+        )
+        assert coll.scrape_once()["fleet"] > 50
+        # The stub's capture mimicry landed as per-replica history.
+        for rid in ("0", "1"):
+            assert db.latest(
+                "rt1_serve_replica_capture_write_errors_total",
+                {"replica_id": rid},
+            ) is not None
+            assert db.latest(
+                "rt1_serve_replica_capture_enabled", {"replica_id": rid}
+            )[1] == 1.0
+        assert mgr.active() == []  # healthy fleet, quiet ruleset
+
+        # Replica 1 goes dark: the fan-out probe books up=0, the next
+        # scrape cycle fires ReplicaDown for exactly that instance.
+        servers[1].shutdown()
+        servers[1].server_close()
+        clock.advance(2.0)
+        coll.scrape_once()
+        active = {
+            (a["alert"], a["labels"].get("replica_id")): a["state"]
+            for a in mgr.active()
+        }
+        assert active == {("ReplicaDown", "1"): "firing"}
+
+        # The fleet heals (respawn into the same slot, supervisor-style):
+        # a fresh up=1 sample overrides and the alert resolves.
+        router.remove_replica(1)
+        app = StubReplicaApp(replica_id=1, mimic_capture=True)
+        httpd = make_stub_server(app)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(httpd)
+        host, port = httpd.server_address[:2]
+        replica = router.add_replica(
+            Replica(1, url=f"http://{host}:{port}")
+        )
+        replica.state = READY
+        clock.advance(2.0)
+        coll.scrape_once()
+        assert [e["event"] for e in mgr.history()] == [
+            "firing",
+            "resolved",
+        ]
+        assert mgr.active() == []
+    finally:
+        for httpd in servers:
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:
+                pass

@@ -729,3 +729,126 @@ def test_deploy_loader_tolerates_torn_record(tmp_path):
     wd.mkdir()
     (wd / "BENCH_deploy.json").write_text('{"bench": "deploy_e2e", ')
     assert run_report.load_deploy(str(wd)) is None
+
+
+# -------------------------------------------------- alerts & history
+
+
+def _canned_obs_workdir(tmp_path):
+    """A workdir as an armed `fleet --collector` run leaves it: a TSDB
+    snapshot holding serve/deploy history plus the scraped-back
+    rt1_alert_* families from a ReplicaDown incident."""
+    from rt1_tpu.obs.tsdb import SNAPSHOT_BASENAME, TSDB
+
+    wd = tmp_path / "obsrun"
+    wd.mkdir()
+    clock = {"t": 1000.0}
+    db = TSDB(clock=lambda: clock["t"])
+    for cycle in range(10):
+        down = 3 <= cycle < 7  # replica 1 dead for scrape cycles 3..6
+        db.append_many(
+            [
+                ("rt1_serve_replica_up", {"replica_id": "0"}, 1.0),
+                (
+                    "rt1_serve_replica_up",
+                    {"replica_id": "1"},
+                    0.0 if down else 1.0,
+                ),
+                ("rt1_serve_slo_requests_total", None, 10.0 * (cycle + 1)),
+                (
+                    "rt1_serve_slo_error_budget_burn_rolling",
+                    None,
+                    25.0 if down else 0.0,
+                ),
+                ("rt1_alert_fired_total", None, 1.0 if cycle >= 3 else 0.0),
+                (
+                    "rt1_alert_resolved_total",
+                    None,
+                    1.0 if cycle >= 7 else 0.0,
+                ),
+                ("rt1_obs_collector_cycles_total", None, float(cycle + 1)),
+            ],
+            t=clock["t"],
+        )
+        if down:
+            db.append(
+                "rt1_alert_firing",
+                1.0,
+                labels={
+                    "alert": "ReplicaDown",
+                    "severity": "page",
+                    "replica_id": "1",
+                },
+                t=clock["t"],
+            )
+        clock["t"] += 2.0
+    db.write_snapshot(str(wd / SNAPSHOT_BASENAME))
+    return str(wd)
+
+
+def test_obs_section_golden(tmp_path):
+    wd = _canned_obs_workdir(tmp_path)
+    obs = run_report.load_obs(wd)
+    assert obs is not None
+    report = run_report.render_report(wd, None, None, None, obs=obs)
+    lines = report.splitlines()
+    assert "## Alerts & history (metrics plane)" in lines
+
+    # The snapshot header line names the file and its bounds.
+    snap_line = next(ln for ln in lines if ln.startswith("Snapshot "))
+    assert "8 series" in snap_line and "74 points" in snap_line
+
+    # The alert timeline reconstructs the incident span from the series:
+    # firing at cycles 3..6 = 6 seconds of scrape coverage, with the
+    # instance labels and lifecycle counters intact.
+    assert any(
+        "fired_total=1" in ln and "resolved_total=1" in ln for ln in lines
+    )
+    incident = next(ln for ln in lines if "ReplicaDown" in ln)
+    assert "[page]" in incident
+    assert "firing" in incident
+    assert "seen    6.0s" in incident
+    assert "replica_id=1" in incident
+
+    # Key signals render as sparklines with the last value, labeled
+    # instances fanned out.
+    assert any(
+        "rt1_serve_replica_up{replica_id=1}" in ln and ln.endswith(" 1")
+        for ln in lines
+    )
+    burn = next(
+        ln
+        for ln in lines
+        if "rt1_serve_slo_error_budget_burn_rolling" in ln
+        and "Key signals" not in ln
+    )
+    assert burn.endswith(" 0")  # decayed back by the last scrape
+    # The non-spark families are counted, not silently dropped.
+    assert any("more stored series" in ln for ln in lines)
+
+
+def test_obs_section_absent_without_snapshot(tmp_path):
+    """A training-only workdir renders no metrics-plane section at all:
+    the golden training report stays byte-stable."""
+    wd = _canned_workdir(tmp_path)
+    assert run_report.load_obs(wd) is None
+    report = run_report.render_report(
+        wd, run_report.load_goodput(wd), run_report.load_flight(wd), None
+    )
+    assert "Alerts & history" not in report
+
+
+def test_obs_loader_tolerates_torn_snapshot(tmp_path):
+    """A SIGKILLed collector's half-written snapshot still loads (torn
+    tail dropped) — the post-mortem exists exactly for that run."""
+    wd = _canned_obs_workdir(tmp_path)
+    from rt1_tpu.obs.tsdb import SNAPSHOT_BASENAME
+
+    path = os.path.join(wd, SNAPSHOT_BASENAME)
+    body = open(path).read().rstrip("\n")
+    with open(path, "w") as f:
+        f.write(body[:-20])
+    obs = run_report.load_obs(wd)
+    assert obs is not None
+    report = run_report.render_report(wd, None, None, None, obs=obs)
+    assert "## Alerts & history (metrics plane)" in report

@@ -203,14 +203,22 @@ def test_autoscaler_shed_and_burn_are_pressure():
     burning = _sig(1, 1, 1, 4, rolling_burn=5.0)  # active traffic + burn
     decision = b.decide(burning)
     assert decision is not None and "burn" in decision.reason
-    # A FROZEN burn reading (no live traffic — the request-indexed
-    # rolling window can never dilute) is evidence about the past, not
-    # pressure: without this, one shed burst pins the fleet at max
-    # forever and scale-down never fires.
+    # The burn signal is TIME-windowed (`SLOLedger.windowed_burn`), so
+    # it is live evidence even with zero active sessions — a restart
+    # burst that orphaned every session must still scale up. The old
+    # request-indexed gauge froze at its peak here, which is why this
+    # case used to be activity-gated to a no-op.
     c = Autoscaler(p)
-    stale_burn = _sig(2, 2, 0, 4, rolling_burn=15.0)
-    assert c.decide(stale_burn) is None  # idle tick 1, not pressure
-    decision = c.decide(stale_burn)  # idle tick 2 -> down
+    quiet_burn = _sig(2, 2, 0, 4, rolling_burn=15.0)
+    decision = c.decide(quiet_burn)
+    assert decision is not None and decision.direction == "up"
+    assert "burn" in decision.reason
+    # Once the wall-clock window passes, the ledger's burn decays to 0
+    # on its own — no traffic needed — and sustained idleness drains.
+    d = Autoscaler(p)
+    decayed = _sig(2, 2, 0, 4, rolling_burn=0.0)
+    assert d.decide(decayed) is None  # idle tick 1
+    decision = d.decide(decayed)  # idle tick 2 -> down
     assert decision is not None and decision.direction == "down"
     # Saturated signal: traffic with zero ready slots is infinite
     # occupancy, i.e. pressure, not a crash.
