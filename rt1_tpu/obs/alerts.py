@@ -560,6 +560,8 @@ def default_ruleset(
     capture_window_s: float = 300.0,
     capture_pruned_threshold: float = 20.0,
     canary_burn_threshold: float = 1.0,
+    migration_window_s: float = 300.0,
+    migration_failures: float = 3.0,
     for_duration_s: float = 0.0,
 ) -> List[AlertRule]:
     """The signals this repo already knows matter, as rules.
@@ -684,6 +686,25 @@ def default_ruleset(
                 "summary": (
                     "Flywheel capture sink under disk pressure: write "
                     "errors or runaway ring pruning."
+                ),
+            },
+        ),
+        AlertRule(
+            name="MigrationFailureStorm",
+            severity="warn",
+            condition=threshold_condition(
+                "rt1_serve_replica_migration_import_failures_total",
+                "increase",
+                migration_window_s,
+                ">=",
+                migration_failures,
+            ),
+            annotations={
+                "summary": (
+                    "Session-snapshot imports repeatedly refused or "
+                    "failing — live migration is degrading to window "
+                    "resets (check checkpoint-generation / engine-mode "
+                    "skew across the fleet)."
                 ),
             },
         ),

@@ -158,6 +158,10 @@ _SERVE_COUNTERS = {
     "sessions_restarted_total": (
         "Sessions re-homed to another replica after theirs died."
     ),
+    "sessions_migrated_total": (
+        "Sessions whose window was carried intact to another replica "
+        "(live migration or snapshot-ring restore)."
+    ),
     "batches_total": "Batched device steps executed.",
     "joined_mid_cycle_total": (
         "Requests that rode a batch formed while another batch was "
@@ -185,6 +189,25 @@ _SERVE_COUNTERS = {
     "cache_rebuild_steps_total": (
         "Per-session full-window cache recomputes (rebuilds after "
         "checkpoint hot-swap invalidation)."
+    ),
+    # Durable sessions (rt1_tpu/serve/migrate.py): the replica-side
+    # export/import/restore legs of live migration and snapshot-ring
+    # crash recovery.
+    "migration_exports_total": (
+        "Session snapshots exported via POST /session/export."
+    ),
+    "migration_imports_total": (
+        "Session snapshots imported via POST /session/import."
+    ),
+    "migration_import_failures_total": (
+        "Session imports refused (compatibility) or failed (malformed)."
+    ),
+    "migration_restores_total": (
+        "Sessions restored from the on-disk snapshot ring at /act time."
+    ),
+    "migration_restore_failures_total": (
+        "Snapshot-ring restores that failed or were refused (stale, "
+        "incompatible, injected fault) — the session restarted fresh."
     ),
 }
 
@@ -446,6 +469,27 @@ _FLEET_REPLICA_FIELDS = {
         "counter",
         "Per-session full-window cache recomputes after invalidation.",
     ),
+    "migration_exports_total": (
+        "counter",
+        "Session snapshots this replica exported (live migration).",
+    ),
+    "migration_imports_total": (
+        "counter",
+        "Session snapshots this replica imported (live migration).",
+    ),
+    "migration_import_failures_total": (
+        "counter",
+        "Session imports this replica refused or failed.",
+    ),
+    "migration_restores_total": (
+        "counter",
+        "Sessions this replica restored from the snapshot ring.",
+    ),
+    "migration_restore_failures_total": (
+        "counter",
+        "Snapshot-ring restores that failed on this replica "
+        "(session restarted fresh).",
+    ),
 }
 
 
@@ -458,7 +502,7 @@ _REPLICA_SLO_FAMILIES = (
         "outcome_total",
         "counter",
         "Router-attributed request outcomes per replica "
-        "(ok | restarted | rejected | failed).",
+        "(ok | migrated | restarted | rejected | failed).",
     ),
     (
         "slo_availability_rolling",

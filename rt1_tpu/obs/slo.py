@@ -7,8 +7,16 @@ serve-side analogue of the train-side `GoodputLedger` — every request
 lands in exactly one outcome class, and the ledger turns the stream into
 an availability / latency / error-budget story:
 
-* ``ok``         — answered 200, full-fidelity (the only class that
-                   counts as *good* for the availability SLO).
+* ``ok``         — answered 200, full-fidelity.
+* ``migrated``   — answered 200 after the session's window was *live-
+                   migrated* to another replica (scale-down drain,
+                   rolling reload, rebalance, or a snapshot-ring crash
+                   restore). The context window survived intact — the
+                   client got token-identical continuity — so this class
+                   counts as *good* for the availability SLO and burns
+                   no error budget. It stays a separate class (not
+                   folded into ``ok``) so post-mortems can see how much
+                   traffic rode the durability layer.
 * ``restarted``  — answered 200 but the session's context window was
                    reset by a replica death. Honest degradation: the
                    client got an action, not the one a surviving replica
@@ -36,7 +44,8 @@ Definitions (classic SRE error-budget arithmetic):
   instead: a quiet minute after an incident reads as burn -> 0, not
   burn-frozen-at-peak. The clock is injectable for tests.
 
-Latency objectives are judged on *answered* requests (ok + restarted):
+Latency objectives are judged on *answered* requests (ok + migrated +
+restarted):
 a shed request has no meaningful latency, and a fleet must not be able
 to "fix" its p99 by rejecting slow traffic into the rejected bucket.
 
@@ -58,7 +67,16 @@ from typing import Any, Deque, Dict, Optional
 
 from rt1_tpu.obs.quantiles import percentile
 
-OUTCOMES = ("ok", "restarted", "rejected", "failed")
+OUTCOMES = ("ok", "migrated", "restarted", "rejected", "failed")
+
+#: Classes that count as *good* for the availability SLO: a migrated
+#: session answered with its window intact — nothing was lost, so it
+#: spends no error budget (unlike ``restarted``, which did lose context).
+GOOD_OUTCOMES = ("ok", "migrated")
+
+#: Classes with a meaningful latency sample (answered 200s) — the set
+#: latency objectives are judged on.
+ANSWERED_OUTCOMES = ("ok", "migrated", "restarted")
 
 SUMMARY_BASENAME = "slo_summary.json"
 
@@ -160,9 +178,10 @@ class SLOLedger:
             )
         now = self._clock()
         with self._lock:
+            good = 1 if outcome in GOOD_OUTCOMES else 0
             self._counts[outcome] += 1
-            self._rolling_good.append(1 if outcome == "ok" else 0)
-            self._timed_good.append((now, 1 if outcome == "ok" else 0))
+            self._rolling_good.append(good)
+            self._timed_good.append((now, good))
             self._evict_timed_locked(now)
             self._latencies[outcome].append(float(latency_s))
 
@@ -179,7 +198,9 @@ class SLOLedger:
 
     def _answered_sorted(self) -> list:
         return sorted(
-            list(self._latencies["ok"]) + list(self._latencies["restarted"])
+            sample
+            for klass in ANSWERED_OUTCOMES
+            for sample in self._latencies[klass]
         )
 
     # ------------------------------------------------- time-windowed view
@@ -206,8 +227,9 @@ class SLOLedger:
     def windowed_availability(
         self, window_s: float, now: Optional[float] = None
     ) -> float:
-        """ok-fraction over the trailing `window_s` seconds; 1.0 when the
-        window holds no requests (no traffic spends no budget)."""
+        """good-fraction (ok + migrated) over the trailing `window_s`
+        seconds; 1.0 when the window holds no requests (no traffic
+        spends no budget)."""
         counts = self.windowed_counts(window_s, now=now)
         if not counts["total"]:
             return 1.0
@@ -238,7 +260,8 @@ class SLOLedger:
         obj = self.objectives
         total = sum(self._counts.values())
         ok = self._counts["ok"]
-        availability = ok / total if total else 1.0
+        good = sum(self._counts[k] for k in GOOD_OUTCOMES)
+        availability = good / total if total else 1.0
         rolling = (
             sum(self._rolling_good) / len(self._rolling_good)
             if self._rolling_good
@@ -250,6 +273,7 @@ class SLOLedger:
         return {
             "slo_requests_total": float(total),
             "slo_requests_ok": float(ok),
+            "slo_requests_migrated": float(self._counts["migrated"]),
             "slo_requests_restarted": float(self._counts["restarted"]),
             "slo_requests_rejected": float(self._counts["rejected"]),
             "slo_requests_failed": float(self._counts["failed"]),
@@ -291,11 +315,12 @@ class SLOLedger:
                     "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
                     "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
                 }
-                if klass != "ok":
+                if klass not in GOOD_OUTCOMES:
                     # This class's share of the error budget: its bad
-                    # fraction over the budget. The non-ok entries sum to
-                    # the total burn, so "who spent the budget" is read
-                    # straight off the summary.
+                    # fraction over the budget. The non-good entries sum
+                    # to the total burn, so "who spent the budget" is
+                    # read straight off the summary. Good classes (ok,
+                    # migrated) carry no burn key at all.
                     entry["error_budget_burn"] = self._burn(
                         1.0 - (self._counts[klass] / total if total else 0.0),
                         obj.error_budget,

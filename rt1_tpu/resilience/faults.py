@@ -45,6 +45,18 @@ Spec grammar (comma-separated)::
                          rollback threshold starting at canary-watch
                          tick 3 (synthetic breach: client traffic stays
                          clean, the decision path is what's under test)
+    migrate_export@1     serve: raise OSError before the 1st session-
+                         export leg of a live migration — the victim
+                         session must degrade to the legacy
+                         orphan+restart path, never a client 5xx
+    migrate_import@2     serve: raise OSError before the 2nd session-
+                         import leg of a live migration (export
+                         succeeded; the snapshot is dropped and the
+                         session restarts on its new replica)
+    session_restore@1    serve: raise OSError on the 1st snapshot-ring
+                         restore a replica attempts for an unknown
+                         session — /act must fall back to a fresh
+                         window (legacy restart), not fail the request
     <site>@<n>x<k>       fire on k consecutive occurrences starting at n
                          (e.g. nan_batch@3x4 poisons batches 3,4,5,6)
 
@@ -98,6 +110,9 @@ KNOWN_SITES = (
     "pack_append",
     "promote",
     "canary_slo_breach",
+    "migrate_export",
+    "migrate_import",
+    "session_restore",
 )
 
 

@@ -149,6 +149,13 @@ def main(argv):
             embed_fn=embedder,
         )
 
+    # Arm chaos sites from the environment (RT1_FAULTS): the fleet
+    # supervisor exports its combined fault spec before spawning so
+    # replica-side sites (session_restore) fire inside this process.
+    from rt1_tpu.resilience import faults
+
+    faults.install_from("")
+
     app = ServeApp(
         engine,
         image_shape=(config.data.height, config.data.width, 3),
@@ -163,6 +170,10 @@ def main(argv):
         slow_threshold_ms=FLAGS.slow_threshold_ms,
         exemplar_path=FLAGS.exemplar_path or None,
         capture=capture,
+        checkpoint_step=step if step is not None else -1,
+        session_snapshot_dir=FLAGS.session_snapshot_dir or None,
+        snapshot_max_age_s=FLAGS.snapshot_max_age_s,
+        snapshot_every=FLAGS.session_snapshot_every,
     )
     app.start(warmup=True)
     if FLAGS.watch_checkpoints_s > 0 and not FLAGS.random_init:
@@ -286,6 +297,22 @@ if __name__ == "__main__":
     flags.DEFINE_string(
         "exemplar_path", "",
         "Dump the slow-request exemplar ring here (JSONL) on drain.")
+    flags.DEFINE_string(
+        "session_snapshot_dir", "",
+        "Durable sessions: write a bounded on-disk snapshot ring of live "
+        "session windows here (rt1_tpu/serve/migrate.py) so a SIGKILL'd "
+        "replica's sessions restore mid-episode at re-home time instead "
+        "of resetting. OFF by default — no disk writes unless an "
+        "operator opts in.")
+    flags.DEFINE_float(
+        "snapshot_max_age_s", 600.0,
+        "Staleness bound for crash restores: a ring snapshot older than "
+        "this starts a fresh window instead (age surfaced as "
+        "snapshot_age_s in the restoring /act response).")
+    flags.DEFINE_integer(
+        "session_snapshot_every", 1,
+        "Write a session's ring snapshot every N served steps (1 = every "
+        "step; higher trades restore staleness for snapshot I/O).")
     flags.DEFINE_string(
         "capture_dir", "",
         "Data flywheel: capture completed sessions as episode .npz files "

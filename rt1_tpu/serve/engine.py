@@ -301,6 +301,45 @@ class PolicyEngine:
                 self._embed_cache.popitem(last=False)
         return vec
 
+    def _embed_key(self, text: str) -> bytes:
+        """The embed-cache key for `text` (BPE token ids, raw-bytes
+        fallback past the CLIP context) — shared by the hit path and the
+        migration seed/peek helpers so they can never disagree."""
+        if self._tokenizer is None:
+            from rt1_tpu.text.clip_bpe import default_tokenizer
+
+            self._tokenizer = default_tokenizer()
+        try:
+            return self._tokenizer.tokenize_text(text).tobytes()
+        except ValueError:  # longer than the 77-token CLIP context
+            return b"raw\x00" + text.encode("utf-8")
+
+    def cached_embedding(self, text: str) -> Optional[np.ndarray]:
+        """The LRU-cached embedding for `text`, or None on a miss. Pure
+        read for the session exporter: no embedder call, no LRU refresh —
+        exporting a session must not change what gets evicted next."""
+        if self._embedder is None:
+            return None
+        key = self._embed_key(text)
+        with self._embed_lock:
+            cached = self._embed_cache.get(key)
+        return None if cached is None else np.asarray(cached, np.float32)
+
+    def seed_embedding(self, text: str, vec) -> None:
+        """Warm the embed LRU with a migrated (instruction, embedding)
+        pair, so the imported session's next text-bearing /act skips the
+        embedder exactly as it would have on its old replica. Does not
+        bump `embed_calls` — nothing was computed here."""
+        if self._embedder is None:
+            return
+        key = self._embed_key(text)
+        value = np.asarray(vec, np.float32)
+        with self._embed_lock:
+            if key not in self._embed_cache:
+                self._embed_cache[key] = value
+                while len(self._embed_cache) > self._embed_cache_size:
+                    self._embed_cache.popitem(last=False)
+
     # ------------------------------------------------------------ compile
 
     def bucket_for(self, active: int) -> int:
@@ -730,6 +769,13 @@ class PolicyEngine:
             )
 
     # ------------------------------------------------------- state migration
+
+    @property
+    def window(self) -> int:
+        """The rolling context window length (model time_sequence_length)
+        — part of the session-snapshot compatibility contract: a snapshot
+        exported under one window length must not land in another."""
+        return int(getattr(self._model, "time_sequence_length", 0))
 
     def state_schema(self) -> List[Tuple[str, Tuple[int, ...], str]]:
         """The per-slot network-state contract: (leaf name, per-slot shape,

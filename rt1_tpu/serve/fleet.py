@@ -672,14 +672,66 @@ class FleetSupervisor:
         self._reclaim_threads.append(thread)
         thread.start()
 
+    def manual_scale_down(
+        self, payload: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Operator-driven elastic drain (router ``POST /scale_down``):
+        reclaim one replica NOW through the same migrating drain the
+        autoscaler uses — live-migrate its sessions, de-place, SIGTERM,
+        reap. ``replica_id`` picks the victim explicitly; omitted, the
+        autoscaler's preference applies (surge tier first, highest id,
+        never replica 0). Raises KeyError/ValueError (router -> 400) on
+        an unknown or unreclaimable victim."""
+        replica_id = payload.get("replica_id")
+        if replica_id is not None and not isinstance(replica_id, int):
+            raise ValueError("'replica_id' must be an integer when given")
+        candidates = [
+            r
+            for r in self._live_replicas()
+            if r.proc is not None
+            and r.id != 0
+            and r.id not in self._reclaiming
+        ]
+        if replica_id is not None:
+            victim = next(
+                (r for r in candidates if r.id == replica_id), None
+            )
+            if victim is None:
+                raise KeyError(
+                    f"replica {replica_id} is not reclaimable (unknown, "
+                    f"already draining, or the pinned replica 0)"
+                )
+        else:
+            if not candidates:
+                raise ValueError("no reclaimable replica")
+            candidates.sort(key=lambda r: (r.tier != TIER_SURGE, -r.id))
+            victim = candidates[0]
+        self._reclaiming.add(victim.id)
+        self.scale_downs += 1
+        self._reclaim_threads = [
+            t for t in self._reclaim_threads if t.is_alive()
+        ]
+        thread = threading.Thread(
+            target=self._reclaim,
+            args=(victim, "manual"),
+            name=f"rt1-fleet-reclaim-{victim.id}",
+            daemon=True,
+        )
+        self._reclaim_threads.append(thread)
+        thread.start()
+        return {"ok": True, "replica_id": victim.id, "draining": True}
+
     def _reclaim(self, victim: Replica, reason: str) -> None:
-        """Graceful scale-down of one replica: de-place (router stops
-        routing to it and orphans its sessions so they re-home through
-        the failover path with ``restarted: true``), give in-flight
-        requests a grace window, snapshot the compile-count evidence,
-        SIGTERM (the replica's own drain path: stop admitting, flush,
-        exit 0), and only then reap the process and purge the id from
-        the routing/metrics maps — no ghost replicas."""
+        """Graceful scale-down of one replica: live-migrate its sessions
+        onto the least-loaded compatible survivor (their next act
+        continues token-identically with ``migrated: true``), de-place
+        (router stops routing to it; any session that could NOT migrate
+        is orphaned so it re-homes through the legacy failover path with
+        ``restarted: true``), give in-flight requests a grace window,
+        snapshot the compile-count evidence, SIGTERM (the replica's own
+        drain path: stop admitting, flush, exit 0), and only then reap
+        the process and purge the id from the routing/metrics maps — no
+        ghost replicas."""
         event: Dict[str, Any] = {
             "direction": "down",
             "replica_id": victim.id,
@@ -688,6 +740,18 @@ class FleetSupervisor:
             "reason": reason,
         }
         try:
+            try:
+                migration = self.router.migrate_sessions_from(
+                    victim.id, reason=f"scale_down:{reason}"
+                )
+                if migration.get("attempted") or migration.get("failed"):
+                    event["sessions_migrated"] = migration["migrated"]
+                    event["migration_failed"] = migration["failed"]
+            except Exception as exc:  # noqa: BLE001 - drain must proceed
+                # Migration is best-effort sugar on top of the drain:
+                # any failure here degrades to the legacy orphan path
+                # below, never wedges the reclaim thread.
+                event["migration_error"] = str(exc)
             self.router.deplace(victim.id)
             time.sleep(self.reclaim_grace_s)
             if victim.url is not None:
@@ -923,11 +987,17 @@ def replica_argv_builder(args) -> Callable[..., List[str]]:
     slow_threshold = getattr(args, "slow_threshold_ms", 0.0)
     scheduler = getattr(args, "scheduler", "continuous")
     buckets = getattr(args, "buckets", "auto")
+    # Durable sessions: ONE shared snapshot directory for the whole fleet
+    # (ring files are keyed per session, writes are atomic) — the replica
+    # a SIGKILL'd session re-homes onto must be able to read the ring
+    # entry its dead home wrote. Empty = off (no disk writes).
+    snapshot_dir = getattr(args, "session_snapshot_dir", "")
+    snapshot_max_age = getattr(args, "snapshot_max_age_s", 600.0)
     if args.stub:
         act_concurrency = getattr(args, "stub_act_concurrency", 0)
 
         def build(replica_id: int, dtype: Optional[str] = None) -> List[str]:
-            return [
+            argv = [
                 sys.executable, "-m", "rt1_tpu.serve.stub",
                 "--port", "0",
                 "--replica_id", str(replica_id),
@@ -942,6 +1012,12 @@ def replica_argv_builder(args) -> Callable[..., List[str]]:
                 # field ("1" = one bucket) unless a ladder is forced.
                 "--buckets", buckets if buckets != "auto" else "1",
             ]
+            if snapshot_dir:
+                argv.extend([
+                    "--session_snapshot_dir", snapshot_dir,
+                    "--snapshot_max_age_s", str(snapshot_max_age),
+                ])
+            return argv
         return build
 
     capture_root = getattr(args, "capture_dir", "")
@@ -966,6 +1042,11 @@ def replica_argv_builder(args) -> Callable[..., List[str]]:
             argv.extend([
                 "--capture_dir",
                 os.path.join(capture_root, f"replica_{replica_id}"),
+            ])
+        if snapshot_dir:
+            argv.extend([
+                "--session_snapshot_dir", snapshot_dir,
+                "--snapshot_max_age_s", str(snapshot_max_age),
             ])
         if args.random_init:
             argv.append("--random_init")
@@ -1040,6 +1121,17 @@ def main(argv=None) -> int:
         "--reclaim_grace_s", type=float, default=0.5,
         help="Seconds between de-placement and SIGTERM on scale-down "
              "(in-flight acts finish inside this window).")
+    parser.add_argument(
+        "--session_snapshot_dir", default="",
+        help="Durable sessions: shared on-disk session-snapshot ring, "
+             "forwarded to every replica (rt1_tpu/serve/migrate.py). A "
+             "SIGKILL'd replica's sessions restore mid-episode on the "
+             "replica they re-home to (booked `migrated`, not "
+             "`restarted`). '' = off.")
+    parser.add_argument(
+        "--snapshot_max_age_s", type=float, default=600.0,
+        help="Crash-restore staleness bound forwarded to every replica "
+             "(older ring snapshots start a fresh window instead).")
     # Router admission control: both knobs default off.
     parser.add_argument(
         "--admission_rate", type=float, default=0.0,
@@ -1209,6 +1301,15 @@ def main(argv=None) -> int:
         )
 
     faults.install_from(args.faults)
+    # Export the combined fault spec so SPAWNED replicas arm their own
+    # sites too (session_restore fires inside the replica process; the
+    # supervisor's in-process plan can't reach it). Popen inherits
+    # os.environ, and replica mains call faults.install_from("").
+    combined_faults = ",".join(
+        s for s in (args.faults, os.environ.get(faults.ENV_VAR, "")) if s
+    )
+    if combined_faults:
+        os.environ[faults.ENV_VAR] = combined_faults
 
     from rt1_tpu.obs.slo import SLOLedger, SLOObjectives
 
@@ -1240,6 +1341,10 @@ def main(argv=None) -> int:
         base_dtype_fn=lambda rid: replica_dtype_for(args, rid),
         reclaim_grace_s=args.reclaim_grace_s,
     )
+    # Elastic-drain seam: POST /scale_down on the router drives the
+    # supervisor's migrating drain (sessions carried to survivors before
+    # the victim is reaped).
+    router.scale_down_fn = supervisor.manual_scale_down
     supervisor.start(wait_ready=True)
 
     controller = None

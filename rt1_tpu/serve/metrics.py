@@ -86,6 +86,10 @@ class ServeMetrics:
         self.reloads_total = 0            # checkpoint hot-swaps served
         self.sessions_restarted_total = 0  # sessions re-homed after a
         #                                    replica death (router-side)
+        self.sessions_migrated_total = 0   # sessions whose window was
+        #                                    live-migrated intact (drain,
+        #                                    rolling reload, rebalance,
+        #                                    snapshot restore; router-side)
         self.batches_total = 0
         self.occupancy_sum = 0
         self.occupancy_max = 0
@@ -146,6 +150,12 @@ class ServeMetrics:
         """One session re-homed (and reset) after its replica died."""
         with self._lock:
             self.sessions_restarted_total += 1
+
+    def observe_session_migration(self) -> None:
+        """One session's window carried intact to another replica (live
+        migration or snapshot-ring restore) — continuity, not a reset."""
+        with self._lock:
+            self.sessions_migrated_total += 1
 
     def observe_batch(
         self,
@@ -322,6 +332,7 @@ class ServeMetrics:
                 "resets_total": self.resets_total,
                 "reloads_total": self.reloads_total,
                 "sessions_restarted_total": self.sessions_restarted_total,
+                "sessions_migrated_total": self.sessions_migrated_total,
                 "requests_per_sec": (
                     self.requests_total / uptime if uptime > 0 else 0.0
                 ),

@@ -1,0 +1,384 @@
+"""Durable sessions: snapshot schema, on-disk ring, and live migration.
+
+The engine grew ``export_session``/``import_session`` (PR 17) as the
+migration seam; this module is the fleet-wide layer on top of it
+(ROADMAP item 3). Three pieces:
+
+* **Wire snapshot** — the versioned, self-describing JSON record a
+  replica's ``POST /session/export`` returns and ``POST /session/import``
+  accepts. ``check_compatibility`` is the gatekeeper: a snapshot exported
+  under a different checkpoint generation, window length, or
+  cached-vs-windowed engine mode is refused with a named
+  ``SnapshotCompatibilityError`` *before* any device memory is touched —
+  the caller then falls back to the legacy orphan+restart path instead of
+  corrupting a slot.
+* **SnapshotRing** — a bounded on-disk ring of per-session snapshots
+  (one JSON file per session, atomic tmp+rename writes, oldest evicted
+  past capacity). Replicas sharing one ring directory give the fleet
+  crash durability: after a SIGKILL the re-home target finds the dead
+  replica's last snapshot and restores the window instead of resetting
+  it. Restore is best-effort and staleness-bounded — ``load`` surfaces
+  the snapshot age so the importer can refuse stale state.
+* **migrate_session** — the one-session live-migration primitive the
+  router and the fleet's scale-down drain share: export from the victim,
+  import into the survivor, never raise. Both legs consult the chaos
+  registry (``migrate_export`` / ``migrate_import`` sites) so fault
+  injection proves a failed migration degrades to orphan+restart, never
+  a 5xx.
+
+State arrays travel base64-encoded raw bytes with an explicit
+shape/dtype header (``encode_state``/``decode_state``); numpy is imported
+lazily inside those two functions only, so the module itself stays
+stdlib-light — the import-blocker probe pins it (with the router and the
+fleet) clu/TF/jax-free. A jax-free exporter (the stub replica) may ship
+plain JSON lists under a ``"data"`` key instead; ``decode_state`` passes
+those through untouched.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from rt1_tpu.resilience import faults
+
+#: Bump on any incompatible change to the snapshot wire schema. Importers
+#: refuse other versions by name — silent best-effort decoding of a
+#: foreign schema is exactly the corruption this layer exists to prevent.
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotCompatibilityError(ValueError):
+    """Snapshot refused: exporter and importer disagree on a contract
+    field (version, checkpoint generation, window length,
+    cached-vs-windowed mode, or state schema)."""
+
+
+# ---------------------------------------------------------------- encoding
+
+
+def encode_state(state: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Host-side state pytree -> JSON-safe ``{leaf: {shape, dtype, b64}}``.
+
+    Raw little-endian bytes under base64 — lossless for every dtype the
+    engine slots hold (int32 token windows, float32/bfloat16-as-float32
+    caches), unlike a float round-trip through JSON text.
+    """
+    import numpy as np
+
+    encoded = {}
+    for name, value in state.items():
+        arr = np.asarray(value)
+        encoded[name] = {
+            "shape": [int(d) for d in arr.shape],
+            "dtype": str(arr.dtype),
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    return encoded
+
+
+def decode_state(encoded: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """Inverse of ``encode_state``. Entries carrying plain ``"data"``
+    lists (a jax-free exporter like the stub) pass through untouched."""
+    decoded: Dict[str, Any] = {}
+    for name, spec in encoded.items():
+        if not isinstance(spec, dict):
+            raise SnapshotCompatibilityError(
+                f"state leaf {name!r} is not an encoded-array object"
+            )
+        if "data" in spec:
+            decoded[name] = spec["data"]
+            continue
+        import numpy as np
+
+        try:
+            raw = base64.b64decode(spec["b64"])
+            arr = np.frombuffer(raw, dtype=np.dtype(spec["dtype"]))
+            decoded[name] = arr.reshape(
+                [int(d) for d in spec["shape"]]
+            ).copy()  # frombuffer views are read-only; importers write
+        except (KeyError, ValueError, TypeError) as exc:
+            raise SnapshotCompatibilityError(
+                f"state leaf {name!r} failed to decode: {exc}"
+            ) from exc
+    return decoded
+
+
+def _norm_schema(schema) -> List[List[Any]]:
+    """Schema triples -> canonical JSON shape ``[[name, [dims], dtype]]``
+    so in-memory tuples compare equal to their JSON round-trip."""
+    return [
+        [str(name), [int(d) for d in shape], str(dtype)]
+        for name, shape, dtype in schema
+    ]
+
+
+def check_compatibility(
+    snapshot: Dict[str, Any],
+    *,
+    checkpoint_generation: Optional[int] = None,
+    window: Optional[int] = None,
+    cached_inference: Optional[bool] = None,
+    schema: Optional[List] = None,
+) -> None:
+    """Refuse a snapshot this importer must not load, naming the field.
+
+    Every keyword left ``None`` is skipped (the importer does not care);
+    every keyword given is compared against the snapshot's self-described
+    value. Raises :class:`SnapshotCompatibilityError` on the first
+    mismatch, returns ``None`` when the snapshot is loadable.
+    """
+    if not isinstance(snapshot, dict):
+        raise SnapshotCompatibilityError(
+            f"snapshot must be a JSON object, got {type(snapshot).__name__}"
+        )
+    version = snapshot.get("version")
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotCompatibilityError(
+            f"snapshot version {version!r} is not the supported version "
+            f"{SNAPSHOT_VERSION} — refusing a foreign schema"
+        )
+    sid = snapshot.get("session_id")
+    if not isinstance(sid, str) or not sid:
+        raise SnapshotCompatibilityError(
+            "snapshot carries no 'session_id'"
+        )
+    if not isinstance(snapshot.get("state"), dict):
+        raise SnapshotCompatibilityError(
+            "snapshot carries no 'state' pytree"
+        )
+    for field, expected in (
+        ("checkpoint_generation", checkpoint_generation),
+        ("window", window),
+        ("cached_inference", cached_inference),
+    ):
+        if expected is None:
+            continue
+        got = snapshot.get(field)
+        if got != expected:
+            raise SnapshotCompatibilityError(
+                f"snapshot {field}={got!r} does not match this importer's "
+                f"{field}={expected!r} — refusing a cross-"
+                + (
+                    "generation"
+                    if field == "checkpoint_generation"
+                    else "mode" if field == "cached_inference" else "window"
+                )
+                + " session snapshot"
+            )
+    if schema is not None:
+        got_schema = snapshot.get("schema")
+        try:
+            normalized = _norm_schema(got_schema)
+        except (TypeError, ValueError) as exc:
+            raise SnapshotCompatibilityError(
+                f"snapshot schema is malformed: {exc}"
+            ) from exc
+        if normalized != _norm_schema(schema):
+            raise SnapshotCompatibilityError(
+                "snapshot state schema does not match this engine's "
+                f"schema — snapshot {normalized} vs engine "
+                f"{_norm_schema(schema)}"
+            )
+
+
+# ----------------------------------------------------------- durability
+
+
+class SnapshotRing:
+    """Bounded on-disk session-snapshot ring (one JSON file per session).
+
+    Writes are atomic (tmp + ``os.replace``) so a SIGKILL mid-write never
+    leaves a torn record; past ``capacity`` live files the oldest (by
+    mtime) are evicted. A whole fleet may share one directory — filenames
+    hash the session id, so two replicas snapshotting the same re-homed
+    session converge on one file and the re-home target finds the dead
+    replica's last write.
+    """
+
+    def __init__(self, directory: str, capacity: int = 64):
+        self.directory = directory
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self.saves = 0
+        self.evictions = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, session_id: str) -> str:
+        digest = hashlib.sha1(
+            session_id.encode("utf-8", "surrogatepass")
+        ).hexdigest()[:20]
+        return os.path.join(self.directory, f"session-{digest}.json")
+
+    def save(self, snapshot: Dict[str, Any]) -> str:
+        """Persist one snapshot (stamping ``saved_at`` if absent);
+        returns the file path. Raises ``OSError`` on write failure —
+        callers treat durability as best-effort and count, not crash."""
+        sid = snapshot.get("session_id")
+        if not isinstance(sid, str) or not sid:
+            raise ValueError("snapshot carries no 'session_id'")
+        record = dict(snapshot)
+        record.setdefault("saved_at", time.time())
+        path = self._path(sid)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with self._lock:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(record, fh)
+            os.replace(tmp, path)
+            self.saves += 1
+            self._evict_locked(keep=path)
+        return path
+
+    def _evict_locked(self, keep: Optional[str] = None) -> None:
+        try:
+            files = [
+                os.path.join(self.directory, name)
+                for name in os.listdir(self.directory)
+                if name.endswith(".json")
+            ]
+        except OSError:
+            return
+        if len(files) <= self.capacity:
+            return
+        files.sort(key=lambda p: (p == keep, _mtime(p)))
+        for path in files[: len(files) - self.capacity]:
+            try:
+                os.remove(path)
+                self.evictions += 1
+            except OSError:
+                pass
+
+    def load(
+        self, session_id: str
+    ) -> Optional[Tuple[Dict[str, Any], Optional[float]]]:
+        """``(snapshot, age_s)`` for a session, or ``None`` when the ring
+        holds nothing usable. ``age_s`` is seconds since ``saved_at``
+        (``None`` when the record carries no timestamp) — the staleness
+        bound the importer enforces and surfaces."""
+        try:
+            with open(self._path(session_id), encoding="utf-8") as fh:
+                record = json.load(fh)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+        if not isinstance(record, dict):
+            return None
+        saved_at = record.get("saved_at")
+        age_s = (
+            max(0.0, time.time() - float(saved_at))
+            if isinstance(saved_at, (int, float))
+            else None
+        )
+        return record, age_s
+
+    def drop(self, session_id: str) -> None:
+        """Forget a session's snapshot (release path) — best-effort."""
+        try:
+            os.remove(self._path(session_id))
+        except OSError:
+            pass
+
+    def __len__(self) -> int:
+        try:
+            return sum(
+                1
+                for name in os.listdir(self.directory)
+                if name.endswith(".json")
+            )
+        except OSError:
+            return 0
+
+
+def _mtime(path: str) -> float:
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+# ------------------------------------------------------------- migration
+
+
+def _post_json(
+    url: str, payload: Dict[str, Any], timeout_s: float
+) -> Tuple[int, Dict[str, Any]]:
+    data = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url,
+        data=data,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 - non-JSON error body
+            body = {"error": f"HTTP {exc.code}"}
+        return exc.code, body
+
+
+def migrate_session(
+    source_url: str,
+    target_url: str,
+    session_id: str,
+    timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """Live-migrate ONE session: export from ``source_url``, import into
+    ``target_url``. Never raises — the result dict carries ``ok`` and,
+    on failure, which ``stage`` broke (``export`` / ``import`` /
+    ``transport``) plus the error string, so callers (scale-down drain,
+    rolling reload, rebalance) log it and fall back to orphan+restart.
+
+    Chaos sites: ``migrate_export`` fires before the export leg,
+    ``migrate_import`` before the import leg — both degrade to the
+    legacy restart path by construction.
+    """
+    try:
+        faults.maybe_fail("migrate_export", what=session_id)
+        status, body = _post_json(
+            source_url.rstrip("/") + "/session/export",
+            {"session_id": session_id},
+            timeout_s,
+        )
+        if status != 200 or not body.get("ok"):
+            return {
+                "ok": False,
+                "session_id": session_id,
+                "stage": "export",
+                "error": str(body.get("error") or f"HTTP {status}"),
+            }
+        snapshot = body.get("snapshot")
+        faults.maybe_fail("migrate_import", what=session_id)
+        status, body = _post_json(
+            target_url.rstrip("/") + "/session/import",
+            {"snapshot": snapshot},
+            timeout_s,
+        )
+        if status != 200 or not body.get("ok"):
+            return {
+                "ok": False,
+                "session_id": session_id,
+                "stage": "import",
+                "error": str(body.get("error") or f"HTTP {status}"),
+            }
+        return {
+            "ok": True,
+            "session_id": session_id,
+            "step_index": body.get("step_index"),
+        }
+    except Exception as exc:  # noqa: BLE001 - migration must never raise
+        return {
+            "ok": False,
+            "session_id": session_id,
+            "stage": "transport",
+            "error": str(exc),
+        }

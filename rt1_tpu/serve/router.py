@@ -23,6 +23,20 @@ fleet behaviors on top:
   dead replica is marked orphaned and picks up the same flag on its next
   act. Failover is bounded (`max_failovers`); past it the router sheds
   with a retryable 503.
+* **Durable sessions / live migration** (`serve/migrate.py`). Planned
+  reclaims do NOT reset windows: the scale-down drain and the rolling
+  reload export each victim session (replica `POST /session/export`)
+  and import it onto the least-loaded compatible survivor BEFORE
+  anything is orphaned — affinity remaps atomically and the client's
+  next act continues token-identically, carrying ``"migrated": true``
+  (an SLO-good outcome class) instead of ``"restarted": true``.
+  `POST /rebalance` moves the N hottest sessions off an overloaded
+  replica through the same path. A replica that restored a window from
+  its crash-durability snapshot ring reports ``session_restored`` and
+  is booked ``migrated`` too. A failed export/import (generation /
+  window / engine-mode skew, injected fault) degrades to the legacy
+  orphan/restart path — the flag flips back to ``restarted``, never a
+  5xx.
 * **Rolling checkpoint reload.** `POST /reload` walks the fleet one
   replica at a time: hot-swap (`serve/server.py` `/reload` — zero-downtime
   in-place), then wait for `/readyz` to report ready again before touching
@@ -91,7 +105,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.obs.slo import OUTCOMES, SLOLedger, SLOObjectives
-from rt1_tpu.serve import reqtrace
+from rt1_tpu.serve import migrate, reqtrace
 from rt1_tpu.serve.metrics import ServeMetrics
 
 # Replica lifecycle as the router sees it. STARTING covers spawn ->
@@ -314,7 +328,16 @@ class Router:
         self.max_tracked_sessions = max_tracked_sessions
         # Sessions whose replica died: their next successful act carries
         # "restarted": true so the client learns its context was reset.
-        self._orphaned: set = set()
+        # Dict-as-ordered-set (values unused): bound eviction must drop
+        # the OLDEST orphan first — set.pop() removed an arbitrary one,
+        # which could silently eat a fresh orphan's restarted flag while
+        # keeping a stale one forever.
+        self._orphaned: Dict[str, None] = {}
+        # Sessions whose window was carried to another replica intact
+        # (live migration or ring restore): their next successful act
+        # carries "migrated": true — continuity, not a reset. Same
+        # ordered-set idiom and bound as the orphan map.
+        self._migrated: Dict[str, None] = {}
         self.replica_timeout_s = replica_timeout_s
         self.max_failovers = max_failovers
         self.reload_timeout_s = reload_timeout_s
@@ -364,6 +387,12 @@ class Router:
         # rt1_obs_collector_* scrape families) absent and the unarmed
         # router byte-identical.
         self.alerts_status_fn: Optional[Callable[[], Dict[str, Any]]] = None
+        # Elastic-drain seam: fleet main points this at the supervisor's
+        # manual scale-down so `POST /scale_down` drives the migrating
+        # drain end to end. Unset = 404 (routers without a supervisor).
+        self.scale_down_fn: Optional[
+            Callable[[Dict[str, Any]], Dict[str, Any]]
+        ] = None
         self.history_fn: Optional[
             Callable[[Dict[str, str]], Dict[str, Any]]
         ] = None
@@ -394,12 +423,26 @@ class Router:
         lost = [s for s, r in self._sessions.items() if r == replica_id]
         for sid in lost:
             del self._sessions[sid]
-            self._orphaned.add(sid)
-        # Bound the orphan set too: a client that dies with its replica
-        # never comes back to consume its restarted flag, and repeated
-        # replica churn would otherwise grow this forever.
+            self._mark_orphaned_locked(sid)
+
+    def _mark_orphaned_locked(self, session_id: str) -> None:
+        """Insertion-ordered add + oldest-first bound eviction: a client
+        that dies with its replica never comes back to consume its
+        restarted flag, and repeated replica churn would otherwise grow
+        this forever. Evicting oldest-first (not set.pop()'s arbitrary
+        pick) guarantees a fresh orphan's flag survives eviction
+        pressure."""
+        self._orphaned.pop(session_id, None)  # re-orphan = newest again
+        self._orphaned[session_id] = None
         while len(self._orphaned) > self.max_tracked_sessions:
-            self._orphaned.pop()
+            del self._orphaned[next(iter(self._orphaned))]
+
+    def _mark_migrated_locked(self, session_id: str) -> None:
+        """Same ordered-set discipline for the migrated-flag map."""
+        self._migrated.pop(session_id, None)
+        self._migrated[session_id] = None
+        while len(self._migrated) > self.max_tracked_sessions:
+            del self._migrated[next(iter(self._migrated))]
 
     def mark_dead(self, replica: Replica, reason: str = "") -> None:
         """Replica is gone: orphan its sessions so their next act re-homes
@@ -442,7 +485,7 @@ class Router:
         with self._lock:
             if self._sessions.get(session_id) == replica_id:
                 del self._sessions[session_id]
-            self._orphaned.add(session_id)
+            self._mark_orphaned_locked(session_id)
 
     # ----------------------------------------------------------- placement
 
@@ -501,7 +544,8 @@ class Router:
         self._sessions.move_to_end(session_id)
         while len(self._sessions) > self.max_tracked_sessions:
             stale, _ = self._sessions.popitem(last=False)
-            self._orphaned.discard(stale)
+            self._orphaned.pop(stale, None)
+            self._migrated.pop(stale, None)
         return best
 
     # -------------------------------------------------------------- canary
@@ -596,7 +640,7 @@ class Router:
                     self._sessions.move_to_end(session_id)  # LRU touch
                     return replica
                 del self._sessions[session_id]
-                self._orphaned.add(session_id)
+                self._mark_orphaned_locked(session_id)
             return self._place_locked(session_id)
 
     # ------------------------------------------------------------- routing
@@ -636,7 +680,12 @@ class Router:
         body.setdefault("request_id", request_id)
         elapsed = time.perf_counter() - t0
         if status == 200 and "error" not in body:
-            outcome = "restarted" if body.get("restarted") else "ok"
+            if body.get("migrated"):
+                outcome = "migrated"
+            elif body.get("restarted"):
+                outcome = "restarted"
+            else:
+                outcome = "ok"
             self._note_act(payload.get("session_id"))
             # Router-side per-task labels under the single-replica family
             # names (the PR 8 convention): fleet-wide task totals on the
@@ -812,10 +861,33 @@ class Router:
                 continue
             if status == 200:
                 with self._lock:
-                    if session_id in self._orphaned:
-                        self._orphaned.discard(session_id)
-                        body["restarted"] = True
-                        self.metrics.observe_session_restart()
+                    if session_id in self._migrated:
+                        # Live migration carried the window intact —
+                        # continuity, not a reset. The migrated flag
+                        # consumes any stale orphan mark from an earlier
+                        # event on the same session.
+                        self._migrated.pop(session_id, None)
+                        self._orphaned.pop(session_id, None)
+                        body["migrated"] = True
+                        self.metrics.observe_session_migration()
+                    elif session_id in self._orphaned:
+                        self._orphaned.pop(session_id, None)
+                        if body.get("session_restored"):
+                            # The replica restored the orphan's window
+                            # from its crash-durability snapshot ring —
+                            # the event happened, but the window
+                            # survived it.
+                            body["migrated"] = True
+                            self.metrics.observe_session_migration()
+                        else:
+                            body["restarted"] = True
+                            self.metrics.observe_session_restart()
+                    elif body.get("session_restored"):
+                        # Restored without the router ever noticing the
+                        # death (e.g. the supervisor respawned between
+                        # acts): still preserved continuity.
+                        body["migrated"] = True
+                        self.metrics.observe_session_migration()
             return status, body, replica.id
         return (
             503,
@@ -838,7 +910,8 @@ class Router:
             with self._lock:
                 rid = self._sessions.pop(session_id, None)
                 was_orphaned = session_id in self._orphaned
-                self._orphaned.discard(session_id)
+                self._orphaned.pop(session_id, None)
+                self._migrated.pop(session_id, None)
                 # A released session is done talking: drop it from the
                 # occupancy signal NOW (an orphaned session stays counted
                 # — its client is alive and about to re-home).
@@ -866,9 +939,199 @@ class Router:
             return 503, {"error": "replica died during reset", "retry": True}
         if status == 200:
             with self._lock:
-                self._orphaned.discard(session_id)  # an explicit reset is
-                #   a client-acknowledged fresh window, not a restart
+                self._orphaned.pop(session_id, None)  # an explicit reset
+                #   is a client-acknowledged fresh window, not a restart
+                self._migrated.pop(session_id, None)
         return status, body
+
+    # ----------------------------------------------------- live migration
+
+    def _compat_surface(self, url: str) -> Optional[Tuple[Any, Any, Any]]:
+        """(checkpoint_generation, window, cached_inference) from a
+        replica's /healthz, or None when the probe failed or the replica
+        predates the migration contract (no generation key — nothing to
+        compare, let the import itself decide)."""
+        status, body = get_json(url + "/healthz", timeout=5.0)
+        if status != 200 or "checkpoint_generation" not in body:
+            return None
+        return (
+            body.get("checkpoint_generation"),
+            body.get("window"),
+            bool(body.get("cached_inference", False)),
+        )
+
+    def migrate_sessions_from(
+        self,
+        replica_id: int,
+        reason: str = "",
+        session_ids: Optional[List[str]] = None,
+        orphan_on_failure: bool = False,
+    ) -> Dict[str, Any]:
+        """Carry sessions off `replica_id` onto the least-loaded READY
+        compatible survivor, one export/import round-trip each
+        (`serve/migrate.py`), remapping affinity atomically on success —
+        the client's next act continues token-identically with
+        ``migrated: true``.
+
+        `session_ids` narrows the move (the /rebalance path); None moves
+        everything homed there (the drain / rolling-reload paths). The
+        pre-flight /healthz compatibility guard skips targets whose
+        checkpoint generation / window / engine mode differ from the
+        source — a doomed import would only burn failure counters (the
+        import itself still refuses, 409, if skew appears between probe
+        and import). Sessions that could not migrate stay mapped unless
+        `orphan_on_failure` (the drain path orphans them NOW so the
+        legacy restart path picks them up; the rolling-reload path leaves
+        them in place — the in-place hot-swap preserves their windows).
+
+        Never raises; the summary dict reports attempted / migrated /
+        failed / skipped with per-session detail.
+        """
+        out: Dict[str, Any] = {
+            "replica_id": replica_id,
+            "reason": reason,
+            "attempted": 0,
+            "migrated": 0,
+            "failed": 0,
+            "sessions": [],
+        }
+        with self._lock:
+            source = self._replicas.get(replica_id)
+            homed = [
+                s for s, r in self._sessions.items() if r == replica_id
+            ]
+        if source is None or source.url is None:
+            out["skipped"] = "source unknown or urlless"
+            return out
+        if session_ids is not None:
+            homed_set = set(homed)
+            homed = [s for s in session_ids if s in homed_set]
+        if not homed:
+            out["skipped"] = "no sessions to migrate"
+            return out
+        source_surface = self._compat_surface(source.url)
+        for sid in homed:
+            target = self._pick_migration_target(
+                replica_id, source_surface
+            )
+            if target is None:
+                entry = {
+                    "session_id": sid,
+                    "ok": False,
+                    "error": "no compatible ready survivor",
+                }
+                out["failed"] += 1
+            else:
+                out["attempted"] += 1
+                result = migrate.migrate_session(
+                    source.url,
+                    target.url,
+                    sid,
+                    timeout_s=self.replica_timeout_s,
+                )
+                entry = {**result, "target_id": target.id}
+                if result.get("ok"):
+                    with self._lock:
+                        # Atomic remap: the next act routes straight to
+                        # the importer (no orphan window in between).
+                        self._sessions[sid] = target.id
+                        self._sessions.move_to_end(sid)
+                        self._orphaned.pop(sid, None)
+                        self._mark_migrated_locked(sid)
+                    out["migrated"] += 1
+                    # Free the source's now-stale copy (best-effort: a
+                    # draining/dying source may not answer, and that's
+                    # fine — it's about to take the slot with it). The
+                    # slot must not leak on a live source (rebalance),
+                    # and a later failover back must not find a stale
+                    # window to silently continue. keep_snapshot: the
+                    # shared ring file now backs the TARGET's session —
+                    # the usual release-drops-snapshot rule would strand
+                    # the importer's crash durability until its next act.
+                    status, _body = post_json(
+                        source.url.rstrip("/") + "/release",
+                        {"session_id": sid, "keep_snapshot": True},
+                        self.replica_timeout_s,
+                    )
+                    entry["source_released"] = status == 200
+                else:
+                    out["failed"] += 1
+            if not entry.get("ok") and orphan_on_failure:
+                self._orphan_session(sid, replica_id)
+                entry["orphaned"] = True
+            out["sessions"].append(entry)
+        return out
+
+    def _pick_migration_target(
+        self,
+        source_id: int,
+        source_surface: Optional[Tuple[Any, Any, Any]],
+    ) -> Optional[Replica]:
+        """Least-loaded READY survivor whose compatibility surface
+        matches the source's (tier-aware on ties, same rule as
+        placement). Recomputed per session: each successful migration
+        shifts the load it balances against."""
+        with self._lock:
+            candidates = [
+                r
+                for r in self._replicas.values()
+                if r.id != source_id
+                and r.state == READY
+                and r.url is not None
+            ]
+            loads: Dict[int, int] = {}
+            for rid in self._sessions.values():
+                loads[rid] = loads.get(rid, 0) + 1
+        if source_surface is not None:
+            candidates = [
+                r
+                for r in candidates
+                if self._compat_surface(r.url) == source_surface
+            ]
+        if not candidates:
+            return None
+        return min(
+            candidates,
+            key=lambda r: (
+                loads.get(r.id, 0),
+                _TIER_RANK.get(r.tier, 0),
+                r.id,
+            ),
+        )
+
+    def hottest_sessions(self, replica_id: int, count: int) -> List[str]:
+        """The `count` most recently acting sessions homed on
+        `replica_id` — the /rebalance victim pick (recency from the
+        occupancy signal; a session that never acted can't be hot)."""
+        with self._lock:
+            homed = {
+                s for s, r in self._sessions.items() if r == replica_id
+            }
+            out: List[str] = []
+            for sid in reversed(self._act_times):
+                if sid in homed:
+                    out.append(sid)
+                    if len(out) >= count:
+                        break
+            return out
+
+    def rebalance(
+        self, replica_id: int, count: int = 1
+    ) -> Tuple[int, Dict[str, Any]]:
+        """POST /rebalance: migrate the `count` hottest sessions off an
+        overloaded replica through the same export/import path the drain
+        uses. Failed migrations leave sessions where they are (the
+        replica is overloaded, not dying — a forced restart would be
+        strictly worse than staying hot)."""
+        with self._lock:
+            known = replica_id in self._replicas
+        if not known:
+            return 404, {"error": f"unknown replica {replica_id}"}
+        victims = self.hottest_sessions(replica_id, count)
+        result = self.migrate_sessions_from(
+            replica_id, reason="rebalance", session_ids=victims
+        )
+        return 200, {"ok": result["failed"] == 0, **result}
 
     # ------------------------------------------------------------- reload
 
@@ -891,11 +1154,23 @@ class Router:
                     {"replica": replica.id, "skipped": replica.state}
                 )
                 continue
+            # Durable sessions: carry this replica's windows to a
+            # compatible survivor before it pays the swap, so no session
+            # waits out the reload. NOT orphan-on-failure — the in-place
+            # hot-swap preserves any session that could not move (late in
+            # the roll every survivor is already on the new generation,
+            # so the compatibility guard correctly keeps them home).
+            migration = self.migrate_sessions_from(
+                replica.id, reason="rolling_reload"
+            )
             payload = {} if step is None else {"step": step}
             status, body = post_json(
                 replica.url + "/reload", payload, self.reload_timeout_s
             )
             entry = {"replica": replica.id, "status": status, **body}
+            if migration["attempted"] or migration["failed"]:
+                entry["sessions_migrated"] = migration["migrated"]
+                entry["migration_failed"] = migration["failed"]
             if status == 0:
                 self.mark_dead(replica, reason=body.get("error", ""))
             elif status == 200:
@@ -1234,6 +1509,30 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 200 if not failed else 502,
                 {"ok": not failed, "replicas": results},
             )
+        elif self.path == "/rebalance":
+            replica_id = payload.get("replica_id")
+            count = payload.get("count", 1)
+            if not isinstance(replica_id, int):
+                self._reply(400, {"error": "'replica_id' must be an "
+                                           "integer"})
+                return
+            if not isinstance(count, int) or count < 1:
+                self._reply(400, {"error": "'count' must be a positive "
+                                           "integer"})
+                return
+            status, body = self.router.rebalance(replica_id, count)
+            self._reply(status, body)
+        elif self.path == "/scale_down":
+            # Elastic-drain entry point: wired to the fleet supervisor's
+            # manual scale-down (migrating drain) by fleet main; 404 on a
+            # router without a supervisor.
+            if self.router.scale_down_fn is None:
+                self._reply(404, {"error": "no fleet supervisor armed"})
+                return
+            try:
+                self._reply(200, self.router.scale_down_fn(payload))
+            except (KeyError, ValueError) as exc:
+                self._reply(400, {"error": str(exc)})
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

@@ -66,7 +66,8 @@ import numpy as np
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.obs.recorder import ExemplarRing
-from rt1_tpu.serve import reqtrace
+from rt1_tpu.resilience import faults
+from rt1_tpu.serve import migrate, reqtrace
 from rt1_tpu.serve.batcher import (
     BusyError,
     ContinuousBatcher,
@@ -161,6 +162,10 @@ class ServeApp:
         slow_capacity: int = 128,
         exemplar_path: Optional[str] = None,
         capture=None,
+        checkpoint_step: int = -1,
+        session_snapshot_dir: Optional[str] = None,
+        snapshot_max_age_s: float = 600.0,
+        snapshot_every: int = 1,
     ):
         self.engine = engine
         # Opt-in data-flywheel episode capture
@@ -181,6 +186,28 @@ class ServeApp:
             capacity=slow_capacity, threshold_ms=slow_threshold_ms
         )
         self.exemplar_path = exemplar_path
+        # Durable sessions (rt1_tpu/serve/migrate.py): the checkpoint
+        # generation stamps exported snapshots and gates imports (a
+        # snapshot from another generation is refused by name); the
+        # optional on-disk snapshot ring gives SIGKILL failover a window
+        # to restore instead of reset, staleness-bounded. Per-session
+        # metadata (step counter, last instruction) rides the snapshot so
+        # the importer can resume bookkeeping and warm its embed cache.
+        self.checkpoint_generation = int(checkpoint_step)
+        self.snapshot_max_age_s = float(snapshot_max_age_s)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.snapshot_ring = (
+            migrate.SnapshotRing(session_snapshot_dir)
+            if session_snapshot_dir
+            else None
+        )
+        self._meta_lock = threading.Lock()
+        self._session_meta: Dict[str, Dict[str, Any]] = {}
+        self.migration_exports = 0
+        self.migration_imports = 0
+        self.migration_import_failures = 0
+        self.migration_restores = 0
+        self.migration_restore_failures = 0
         # reload_fn(step|None) -> (variables, checkpoint_step): the standby
         # restore path behind POST /reload (eval/restore.py
         # load_standby_variables closed over config+workdir).
@@ -313,6 +340,17 @@ class ServeApp:
         flywheel episodes."""
         if phases is None:
             phases = reqtrace.RequestPhases()
+        # Crash durability: if this session has no live window here but a
+        # ring snapshot exists (we re-homed after a replica died), restore
+        # the window before stepping — the client continues mid-episode
+        # instead of silently restarting at step 0. Best-effort: any
+        # failure (stale, incompatible, injected fault) falls back to the
+        # legacy fresh-window path.
+        restored = (
+            self.maybe_restore_session(session_id)
+            if self.snapshot_ring is not None
+            else None
+        )
         t_entry = time.perf_counter()
         while True:
             with self._admit_lock:
@@ -380,6 +418,9 @@ class ServeApp:
                 session_started=result.get("session_started", False),
                 terminate=bool(result.get("terminate_episode", 0)),
             )
+        self._note_act(session_id, obs, result)
+        if restored:
+            result.update(restored)
         return result
 
     def reset(self, session_id: str) -> int:
@@ -388,13 +429,217 @@ class ServeApp:
         slot = self.engine.reset(session_id)
         if self.capture is not None:
             self.capture.finalize(session_id, "reset")
+        with self._meta_lock:
+            meta = self._session_meta.get(session_id)
+            if meta is not None:
+                meta["step_index"] = 0
+        if self.snapshot_ring is not None:
+            # A client-requested fresh window invalidates the durable
+            # copy — restoring it after a reset would resurrect the
+            # episode the client just abandoned.
+            self.snapshot_ring.drop(session_id)
         return slot
 
-    def release(self, session_id: str) -> None:
-        """Engine release + capture finalize (outcome "released")."""
+    def release(self, session_id: str, keep_snapshot: bool = False) -> None:
+        """Engine release + capture finalize. ``keep_snapshot`` is the
+        migration-cleanup variant (the router freeing the source's stale
+        copy after a successful import): the shared ring file now backs
+        the importer's session, so it must survive this release — and
+        the capture outcome is "migrated", not "released", because the
+        episode continues elsewhere."""
         self.engine.release(session_id)
         if self.capture is not None:
-            self.capture.finalize(session_id, "released")
+            self.capture.finalize(
+                session_id, "migrated" if keep_snapshot else "released"
+            )
+        with self._meta_lock:
+            self._session_meta.pop(session_id, None)
+        if self.snapshot_ring is not None and not keep_snapshot:
+            self.snapshot_ring.drop(session_id)
+
+    # ------------------------------------------------------------------
+    # Durable sessions: export/import/restore (rt1_tpu/serve/migrate.py)
+    # ------------------------------------------------------------------
+
+    def _note_act(
+        self,
+        session_id: str,
+        obs: Dict[str, Any],
+        result: Dict[str, Any],
+    ) -> None:
+        """Post-step bookkeeping: advance the per-session step counter
+        (it rides exported snapshots so an importer resumes counting, not
+        restarts at 0) and, when the snapshot ring is on, write the
+        periodic incremental checkpoint. Best-effort by construction — a
+        full disk or a racing release must never fail the served step."""
+        with self._meta_lock:
+            meta = self._session_meta.setdefault(
+                session_id, {"step_index": 0}
+            )
+            if result.get("session_started"):
+                meta["step_index"] = 0
+            meta["step_index"] = int(meta["step_index"]) + 1
+            instruction = obs.get("instruction")
+            if isinstance(instruction, str) and instruction:
+                meta["instruction"] = instruction
+            steps = meta["step_index"]
+        if (
+            self.snapshot_ring is not None
+            and steps % self.snapshot_every == 0
+        ):
+            try:
+                self.snapshot_ring.save(self._build_snapshot(session_id))
+            except Exception:
+                pass  # durability is advisory; the answer already shipped
+
+    def _build_snapshot(self, session_id: str) -> Dict[str, Any]:
+        """Wire-format session snapshot: the engine's rolling state (and
+        KV cache leaves when cached inference is on) plus everything the
+        importer needs to validate and resume — schema, step counter,
+        checkpoint generation, window length, and the instruction (with
+        its cached embedding, so the target's embed cache warms without a
+        recompute)."""
+        base = self.engine.export_session(session_id)
+        with self._meta_lock:
+            meta = dict(self._session_meta.get(session_id, {}))
+        snapshot: Dict[str, Any] = {
+            "version": migrate.SNAPSHOT_VERSION,
+            "session_id": session_id,
+            "step_index": int(meta.get("step_index", 0)),
+            "checkpoint_generation": self.checkpoint_generation,
+            "window": int(getattr(self.engine, "window", 0)),
+            "cached_inference": bool(base.get("cached_inference", False)),
+            "schema": [
+                [name, list(shape), dtype]
+                for name, shape, dtype in base["schema"]
+            ],
+            "state": migrate.encode_state(base["state"]),
+        }
+        instruction = meta.get("instruction")
+        if instruction:
+            snapshot["instruction"] = instruction
+            cached = None
+            try:
+                cached = self.engine.cached_embedding(instruction)
+            except Exception:
+                cached = None
+            if cached is not None:
+                snapshot["embedding"] = [float(x) for x in cached]
+        return snapshot
+
+    def export_session(self, session_id: str) -> Dict[str, Any]:
+        """POST /session/export body: snapshot this session for transport
+        to another replica. Pure read — the session keeps serving here
+        until the importer confirms and the router remaps affinity."""
+        snapshot = self._build_snapshot(session_id)
+        with self._meta_lock:
+            self.migration_exports += 1
+        return snapshot
+
+    def import_session(
+        self,
+        snapshot: Dict[str, Any],
+        session_id: Optional[str] = None,
+        _count: bool = True,
+    ) -> Dict[str, Any]:
+        """POST /session/import body: validate a wire snapshot against
+        this replica's generation/window/mode/schema, then scatter it
+        into a slot. Refusals raise SnapshotCompatibilityError (HTTP 409)
+        naming the mismatched field; the caller falls back to the legacy
+        orphan/restart path. `_count=False` is the crash-restore path,
+        which books migration_restores instead of migration_imports."""
+        try:
+            migrate.check_compatibility(
+                snapshot,
+                checkpoint_generation=self.checkpoint_generation,
+                window=int(getattr(self.engine, "window", 0)),
+                cached_inference=bool(
+                    getattr(self.engine, "cached_inference", False)
+                ),
+                schema=self.engine.state_schema(),
+            )
+            state = migrate.decode_state(snapshot["state"])
+            slot = self.engine.import_session(
+                {
+                    "session_id": snapshot["session_id"],
+                    "state": state,
+                },
+                session_id=session_id,
+            )
+        except Exception:
+            if _count:
+                with self._meta_lock:
+                    self.migration_import_failures += 1
+            raise
+        sid = session_id or str(snapshot["session_id"])
+        instruction = snapshot.get("instruction")
+        embedding = snapshot.get("embedding")
+        if instruction and embedding is not None:
+            try:
+                self.engine.seed_embedding(instruction, embedding)
+            except Exception:
+                pass  # a cold embed cache is a recompute, not an error
+        step_index = int(snapshot.get("step_index", 0))
+        with self._meta_lock:
+            meta = self._session_meta.setdefault(sid, {"step_index": 0})
+            meta["step_index"] = step_index
+            if instruction:
+                meta["instruction"] = instruction
+            if _count:
+                self.migration_imports += 1
+        return {
+            "session_id": sid,
+            "slot": int(slot),
+            "step_index": step_index,
+        }
+
+    def maybe_restore_session(
+        self, session_id: str
+    ) -> Optional[Dict[str, Any]]:
+        """Crash-durability hook on the /act path: if this session has no
+        live window here but the snapshot ring holds one (we re-homed
+        after a SIGKILL), restore it — staleness-bounded, best-effort.
+        Returns the response fields to merge (`session_restored`,
+        `snapshot_age_s`) or None for the legacy fresh-window path."""
+        ring = self.snapshot_ring
+        if ring is None:
+            return None
+        try:
+            if session_id in self.engine.session_ids():
+                return None
+        except Exception:
+            return None
+        loaded = ring.load(session_id)
+        if loaded is None:
+            return None
+        snapshot, age_s = loaded
+        try:
+            faults.maybe_fail("session_restore", what=session_id)
+            if age_s is not None and age_s > self.snapshot_max_age_s:
+                raise migrate.SnapshotCompatibilityError(
+                    "session snapshot for %r is %.1fs old, past the "
+                    "%.1fs staleness bound — starting a fresh window"
+                    % (session_id, age_s, self.snapshot_max_age_s)
+                )
+            result = self.import_session(
+                snapshot, session_id=session_id, _count=False
+            )
+        except Exception:
+            with self._meta_lock:
+                self.migration_restore_failures += 1
+            # A snapshot that failed once will fail again — drop it so
+            # the next /act takes the fresh-window path immediately.
+            ring.drop(session_id)
+            return None
+        with self._meta_lock:
+            self.migration_restores += 1
+        out: Dict[str, Any] = {
+            "session_restored": True,
+            "step_index_restored": result["step_index"],
+        }
+        if age_s is not None:
+            out["snapshot_age_s"] = round(float(age_s), 3)
+        return out
 
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful shutdown: reject new work, flush everything admitted.
@@ -449,6 +694,13 @@ class ServeApp:
             variables, restored_step = self._reload_fn(step)
             info = self.engine.swap_variables(variables)
             self.metrics.observe_reload()
+            if restored_step is not None:
+                # New weights, new snapshot generation: a rolling state
+                # exported under the old checkpoint must not be stepped
+                # by the new one (the compatibility check refuses it by
+                # generation, so the caller falls back to a fresh window
+                # instead of silently mixing weights).
+                self.checkpoint_generation = int(restored_step)
             return {
                 "ok": True,
                 "checkpoint_step": restored_step,
@@ -475,6 +727,13 @@ class ServeApp:
             "cached_inference": bool(
                 getattr(self.engine, "cached_inference", False)
             ),
+            # Migration compatibility surface: a router compares these
+            # before shipping a session snapshot here (a mismatched
+            # generation/window/mode import would be refused anyway —
+            # checking first keeps failure counters honest).
+            "checkpoint_generation": self.checkpoint_generation,
+            "window": int(getattr(self.engine, "window", 0)),
+            "session_snapshots": self.snapshot_ring is not None,
             # The serve hot-path contract (ISSUE 12): which scheduler
             # forms batches and which AOT bucket sizes exist —
             # compile_count is pinned at len(buckets) after warm-up.
@@ -551,6 +810,19 @@ class ServeApp:
             "cache_invalidations": dict(
                 getattr(self.engine, "cache_invalidations", {})
                 or {"swap": 0, "reset": 0, "evict": 0}
+            ),
+            # Durable-session counters (rt1_serve_migration_*): always
+            # present so dashboards can tell "migration idle" from "not
+            # deployed". exports/imports are the live-migration transport;
+            # restores are the crash-durability ring path.
+            "migration_exports_total": self.migration_exports,
+            "migration_imports_total": self.migration_imports,
+            "migration_import_failures_total": (
+                self.migration_import_failures
+            ),
+            "migration_restores_total": self.migration_restores,
+            "migration_restore_failures_total": (
+                self.migration_restore_failures
             ),
             # Flywheel capture gauges (rt1_serve_capture_*): enabled flag
             # always present so dashboards can tell "off" from "zero".
@@ -652,11 +924,66 @@ class _Handler(BaseHTTPRequestHandler):
             self._session_op(payload, self.app.reset, "slot",
                              count_reset=True)
         elif self.path == "/release":
-            self._session_op(payload, self.app.release, None)
+            self._session_op(
+                payload,
+                lambda sid: self.app.release(
+                    sid, keep_snapshot=bool(payload.get("keep_snapshot"))
+                ),
+                None,
+            )
         elif self.path == "/reload":
             self._reload(payload)
+        elif self.path == "/session/export":
+            self._session_export(payload)
+        elif self.path == "/session/import":
+            self._session_import(payload)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def _session_export(self, payload):
+        try:
+            snapshot = self.app.export_session(self._session_id(payload))
+        except RequestError as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        except SessionError as exc:
+            self._reply(404, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - export must not 500-loop
+            self._reply(500, {"error": f"export failed: {exc}"})
+            return
+        self._reply(200, {"ok": True, "snapshot": snapshot})
+
+    def _session_import(self, payload):
+        snapshot = payload.get("snapshot")
+        if not isinstance(snapshot, dict):
+            self._reply(400, {"error": "'snapshot' must be a JSON object"})
+            return
+        session_id = payload.get("session_id")
+        if session_id is not None and (
+            not isinstance(session_id, str) or not session_id
+        ):
+            self._reply(400, {"error": "'session_id' must be a non-empty "
+                                       "string when given"})
+            return
+        try:
+            result = self.app.import_session(snapshot, session_id=session_id)
+        except migrate.SnapshotCompatibilityError as exc:
+            # Before ValueError: it IS a ValueError, but a refusal is a
+            # conflict with this replica's generation/window/mode (409),
+            # not a malformed request (400).
+            self._reply(409, {"error": str(exc)})
+            return
+        except SlotContentionError as exc:
+            self._reply(503, {"error": str(exc), "retry": True})
+            return
+        except (RequestError, SessionError, ValueError) as exc:
+            self._reply(400, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 - import must not crash
+            self._reply(500, {"error": f"import failed: {exc}"})
+            return
+        self._reply(200, {"ok": True, **result})
 
     def _reload(self, payload):
         step = payload.get("step")
@@ -806,6 +1133,13 @@ class _Handler(BaseHTTPRequestHandler):
             out["phases"] = breakdown
         if "terminate_episode" in result:
             out["terminate_episode"] = result["terminate_episode"]
+        if result.get("session_restored"):
+            # Crash durability: this step resumed a ring-snapshotted
+            # window instead of starting fresh — the router books the
+            # outcome as `migrated`, not `restarted`.
+            out["session_restored"] = True
+            if "snapshot_age_s" in result:
+                out["snapshot_age_s"] = result["snapshot_age_s"]
         self._reply(200, out)
 
 

@@ -43,11 +43,21 @@ from typing import Any, Dict, Tuple
 from rt1_tpu.obs import prometheus as obs_prometheus
 from rt1_tpu.obs import trace as obs_trace
 from rt1_tpu.obs.recorder import ExemplarRing
-from rt1_tpu.serve import reqtrace
+from rt1_tpu.resilience import faults
+from rt1_tpu.serve import migrate, reqtrace
 from rt1_tpu.serve.metrics import ServeMetrics
 
 IMAGE_SHAPE = (8, 14, 3)  # tiny but nonzero: loadgen reads this contract
 EMBED_DIM = 16
+# Advertised rolling-window length (protocol double for the real
+# engine's model.time_sequence_length): part of the snapshot
+# compatibility surface, so fleet tests can prove window-mismatch
+# refusal with no model.
+STUB_WINDOW = 6
+# The stub's one-leaf snapshot schema: its whole session state is the
+# step counter, shipped as a plain JSON list (`data`) so migration
+# round-trips with zero numpy.
+STUB_SCHEMA = [("stub_step", (), "int64")]
 
 
 def stub_action(step: int, dims: int = 2):
@@ -70,6 +80,8 @@ class StubReplicaApp:
         act_concurrency: int = 0,
         cached_inference: bool = False,
         mimic_capture: bool = False,
+        session_snapshot_dir=None,
+        snapshot_max_age_s: float = 600.0,
     ):
         self.replica_id = replica_id
         self.max_sessions = max_sessions
@@ -123,6 +135,25 @@ class StubReplicaApp:
         self.reloading = False
         self.reloads = 0
         self.checkpoint_step = -1
+        # Durable sessions, mimicked exactly (protocol double for
+        # rt1_tpu/serve/migrate.py on the real replica): the snapshot is
+        # the session's step counter under the same versioned wire schema
+        # — so the tier-1 fleet tests prove live migration, affinity
+        # remap, crash restore, and the failed-import fallback with zero
+        # jax boots. `checkpoint_generation` tracks /reload's step so a
+        # test can manufacture cross-generation refusals.
+        self.checkpoint_generation = -1
+        self.snapshot_max_age_s = float(snapshot_max_age_s)
+        self.snapshot_ring = (
+            migrate.SnapshotRing(session_snapshot_dir)
+            if session_snapshot_dir
+            else None
+        )
+        self.migration_exports = 0
+        self.migration_imports = 0
+        self.migration_import_failures = 0
+        self.migration_restores = 0
+        self.migration_restore_failures = 0
         self._lock = threading.Lock()
         self._reload_lock = threading.Lock()  # one reload at a time (409)
         self._sessions: Dict[str, int] = {}  # session -> next step index
@@ -175,6 +206,13 @@ class StubReplicaApp:
             return 400, {"error": "payload needs 'image' or 'image_b64'"}
         if self.draining:
             return 503, {"error": "draining"}
+        # Crash durability, mimicked: an unknown session with a ring
+        # snapshot resumes mid-episode instead of restarting at step 0.
+        restored = (
+            self._maybe_restore(session_id)
+            if self.snapshot_ring is not None
+            else None
+        )
         t0 = time.perf_counter()
         # The stub has no real batcher: admission, queue, and formation
         # collapse to back-to-back stamps (their deltas read ~0 ms, which
@@ -223,7 +261,12 @@ class StubReplicaApp:
         self.metrics.observe_bucket(
             next((b for b in self.buckets if b >= 1), 1), 1
         )
-        return 200, {
+        if self.snapshot_ring is not None:
+            try:
+                self.snapshot_ring.save(self._build_snapshot(session_id))
+            except Exception:
+                pass  # durability is advisory; the answer already shipped
+        body = {
             "action": stub_action(step),
             "action_tokens": [0, step % 256, (step * 3) % 256],
             "session_started": started,
@@ -231,6 +274,9 @@ class StubReplicaApp:
             "replica_id": self.replica_id,
             "step_index": step,
         }
+        if restored:
+            body.update(restored)
+        return 200, body
 
     def reset(self, payload) -> Tuple[int, Dict[str, Any]]:
         session_id = payload.get("session_id")
@@ -244,6 +290,8 @@ class StubReplicaApp:
                     self.capture_episodes += 1  # episode boundary
             self._sessions[session_id] = 0
             slot = list(self._sessions).index(session_id)
+        if self.snapshot_ring is not None:
+            self.snapshot_ring.drop(session_id)
         self.metrics.observe_reset()
         return 200, {"ok": True, "slot": slot}
 
@@ -255,7 +303,130 @@ class StubReplicaApp:
                 self.capture_episodes += 1  # episode boundary
         if known is None:
             return 404, {"error": f"unknown session {session_id!r}"}
+        # keep_snapshot: migration cleanup releasing the source's stale
+        # copy — the shared ring file now backs the importer's session.
+        if self.snapshot_ring is not None and not payload.get(
+            "keep_snapshot"
+        ):
+            self.snapshot_ring.drop(session_id)
         return 200, {"ok": True}
+
+    # ------------------------------------------------- durable sessions
+
+    def _build_snapshot(self, session_id: str) -> Dict[str, Any]:
+        with self._lock:
+            if session_id not in self._sessions:
+                raise KeyError(f"unknown session {session_id!r}")
+            next_step = self._sessions[session_id]
+        return {
+            "version": migrate.SNAPSHOT_VERSION,
+            "session_id": session_id,
+            "step_index": next_step,
+            "checkpoint_generation": self.checkpoint_generation,
+            "window": STUB_WINDOW,
+            "cached_inference": self.cached_inference,
+            "schema": [
+                [name, list(shape), dtype]
+                for name, shape, dtype in STUB_SCHEMA
+            ],
+            "state": {"stub_step": {"data": [next_step]}},
+        }
+
+    def session_export(self, payload) -> Tuple[int, Dict[str, Any]]:
+        session_id = payload.get("session_id")
+        if not isinstance(session_id, str) or not session_id:
+            return 400, {"error": "'session_id' must be a non-empty string"}
+        try:
+            snapshot = self._build_snapshot(session_id)
+        except KeyError as exc:
+            return 404, {"error": str(exc)}
+        with self._lock:
+            self.migration_exports += 1
+        return 200, {"ok": True, "snapshot": snapshot}
+
+    def import_session(
+        self,
+        snapshot: Dict[str, Any],
+        session_id=None,
+        _count: bool = True,
+    ) -> Dict[str, Any]:
+        """Validate a wire snapshot against this stub's generation /
+        window / mode / schema — the same refusal surface as the real
+        replica — then resume the session's step counter. Raises
+        SnapshotCompatibilityError on refusal (HTTP 409)."""
+        try:
+            migrate.check_compatibility(
+                snapshot,
+                checkpoint_generation=self.checkpoint_generation,
+                window=STUB_WINDOW,
+                cached_inference=self.cached_inference,
+                schema=STUB_SCHEMA,
+            )
+            step_index = int(snapshot.get("step_index", 0))
+        except Exception:
+            if _count:
+                with self._lock:
+                    self.migration_import_failures += 1
+            raise
+        sid = session_id or str(snapshot["session_id"])
+        with self._lock:
+            self._sessions[sid] = step_index
+            slot = list(self._sessions).index(sid)
+            if _count:
+                self.migration_imports += 1
+        return {"session_id": sid, "slot": slot, "step_index": step_index}
+
+    def session_import(self, payload) -> Tuple[int, Dict[str, Any]]:
+        snapshot = payload.get("snapshot")
+        if not isinstance(snapshot, dict):
+            return 400, {"error": "'snapshot' must be a JSON object"}
+        session_id = payload.get("session_id")
+        if session_id is not None and (
+            not isinstance(session_id, str) or not session_id
+        ):
+            return 400, {"error": "'session_id' must be a non-empty "
+                                  "string when given"}
+        try:
+            result = self.import_session(snapshot, session_id=session_id)
+        except migrate.SnapshotCompatibilityError as exc:
+            return 409, {"error": str(exc)}
+        except (ValueError, KeyError) as exc:
+            return 400, {"error": str(exc)}
+        return 200, {"ok": True, **result}
+
+    def _maybe_restore(self, session_id: str):
+        with self._lock:
+            if session_id in self._sessions:
+                return None
+        loaded = self.snapshot_ring.load(session_id)
+        if loaded is None:
+            return None
+        snapshot, age_s = loaded
+        try:
+            faults.maybe_fail("session_restore", what=session_id)
+            if age_s is not None and age_s > self.snapshot_max_age_s:
+                raise migrate.SnapshotCompatibilityError(
+                    "session snapshot for %r is %.1fs old, past the "
+                    "%.1fs staleness bound" % (
+                        session_id, age_s, self.snapshot_max_age_s)
+                )
+            result = self.import_session(
+                snapshot, session_id=session_id, _count=False
+            )
+        except Exception:
+            with self._lock:
+                self.migration_restore_failures += 1
+            self.snapshot_ring.drop(session_id)
+            return None
+        with self._lock:
+            self.migration_restores += 1
+        out = {
+            "session_restored": True,
+            "step_index_restored": result["step_index"],
+        }
+        if age_s is not None:
+            out["snapshot_age_s"] = round(float(age_s), 3)
+        return out
 
     def reload(self, payload) -> Tuple[int, Dict[str, Any]]:
         # Same one-reload-at-a-time contract as ServeApp._reload_lock —
@@ -268,6 +439,10 @@ class StubReplicaApp:
             time.sleep(self.reload_delay_s)  # the restore-and-validate cost
             self.reloads += 1
             self.checkpoint_step = payload.get("step", -1)
+            # New weights, new snapshot generation — same contract as the
+            # real replica: imports of old-generation snapshots are
+            # refused by name after a reload lands a different step.
+            self.checkpoint_generation = self.checkpoint_step
             self.metrics.observe_reload()
             caches_rebuilt = 0
             if self.cached_inference:
@@ -309,6 +484,12 @@ class StubReplicaApp:
             "reloads": self.reloads,
             "inference_dtype": self.inference_dtype,
             "cached_inference": self.cached_inference,
+            # Migration compatibility surface (same keys as the real
+            # replica): a router compares these before shipping a
+            # session snapshot here.
+            "checkpoint_generation": self.checkpoint_generation,
+            "window": STUB_WINDOW,
+            "session_snapshots": self.snapshot_ring is not None,
         }
 
     def readyz(self) -> Tuple[int, Dict[str, Any]]:
@@ -347,6 +528,33 @@ class StubReplicaApp:
             "cache_cached_steps_total": self.cache_cached_steps,
             "cache_rebuild_steps_total": self.cache_rebuild_steps,
             "cache_invalidations": dict(self.cache_invalidations),
+            # Durable-session counters ride only once migration is armed
+            # or has happened (same conditional-spread rule as capture):
+            # an unarmed stub's /metrics stays byte-identical, while any
+            # fleet that migrates/restores renders every
+            # rt1_serve_replica_migration_* family the alert rules watch.
+            **(
+                {
+                    "migration_exports_total": self.migration_exports,
+                    "migration_imports_total": self.migration_imports,
+                    "migration_import_failures_total": (
+                        self.migration_import_failures
+                    ),
+                    "migration_restores_total": self.migration_restores,
+                    "migration_restore_failures_total": (
+                        self.migration_restore_failures
+                    ),
+                }
+                if (
+                    self.snapshot_ring is not None
+                    or self.migration_exports
+                    or self.migration_imports
+                    or self.migration_import_failures
+                    or self.migration_restores
+                    or self.migration_restore_failures
+                )
+                else {}
+            ),
             # Capture-family mimicry rides ONLY behind the flag: keys
             # absent by default keeps the unarmed stub's /metrics (and
             # the fleet fan-out built from it) byte-identical.
@@ -436,6 +644,8 @@ class _StubHandler(BaseHTTPRequestHandler):
             "/reset": self.app.reset,
             "/release": self.app.release,
             "/reload": self.app.reload,
+            "/session/export": self.app.session_export,
+            "/session/import": self.app.session_import,
         }
         op = ops.get(self.path)
         if op is None:
@@ -499,7 +709,21 @@ def main(argv=None) -> int:
         help="Advertise KV-cached incremental decode and mimic its "
              "counter families (protocol double for the real replica's "
              "--cached_inference).")
+    parser.add_argument(
+        "--session_snapshot_dir", default="",
+        help="Durable sessions: bounded on-disk snapshot ring (protocol "
+             "double for the real replica's --session_snapshot_dir; "
+             "SIGKILL'd sessions restore mid-episode at re-home time).")
+    parser.add_argument(
+        "--snapshot_max_age_s", type=float, default=600.0,
+        help="Staleness bound for crash restores (snapshots older than "
+             "this start a fresh window).")
     args = parser.parse_args(argv)
+
+    # Arm chaos sites from the environment (RT1_FAULTS): the fleet
+    # supervisor exports its combined fault spec before spawning so
+    # replica-side sites (session_restore) fire inside this process.
+    faults.install_from("")
 
     # Bounded in-process trace ring so GET /trace (and the fleet tests'
     # span-propagation assertions) see real replica-side spans.
@@ -516,6 +740,8 @@ def main(argv=None) -> int:
         act_concurrency=args.act_concurrency,
         cached_inference=args.cached_inference,
         mimic_capture=args.mimic_capture,
+        session_snapshot_dir=args.session_snapshot_dir or None,
+        snapshot_max_age_s=args.snapshot_max_age_s,
     )
     httpd = make_stub_server(app, host=args.host, port=args.port)
     # Graceful drain on SIGTERM — the same contract the real replica's

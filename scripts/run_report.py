@@ -154,6 +154,13 @@ def load_serve(workdir: str) -> Optional[Dict[str, Any]]:
                 out["elastic_bench"] = json.load(f)
         except (json.JSONDecodeError, OSError):
             pass  # half-written record from a killed A/B
+    path = os.path.join(workdir, "BENCH_serve_migration.json")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                out["migration_bench"] = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            pass  # half-written record from a killed A/B
     path = os.path.join(workdir, "slow_requests.jsonl")
     if os.path.exists(path):
         try:
@@ -735,6 +742,7 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
     bench = serve.get("bench") if serve else None
     quant = serve.get("quant_bench") if serve else None
     elastic = serve.get("elastic_bench") if serve else None
+    migration = serve.get("migration_bench") if serve else None
     exemplars = serve.get("exemplars") if serve else None
     if (
         slo is None
@@ -742,6 +750,7 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
         and exemplars is None
         and quant is None
         and elastic is None
+        and migration is None
     ):
         lines.append(
             "No serving artifacts (slo_summary.json / BENCH_serve_*.json / "
@@ -850,6 +859,8 @@ def render_serve(serve: Optional[Dict[str, Any]], tail: int = 8) -> List[str]:
             lines.append(f"Note: {note}")
     if elastic is not None:
         lines.extend(_render_elastic(elastic))
+    if migration is not None:
+        lines.extend(_render_migration(migration))
     records = (exemplars or {}).get("records", [])
     if exemplars is not None:
         header = exemplars.get("header", {})
@@ -952,6 +963,74 @@ def _render_elastic(elastic: Dict[str, Any]) -> List[str]:
                 f"  Peak-phase p99: elastic {env.get('elastic_ms')} ms vs "
                 f"fixed-max {env.get('fixed_max_ms')} ms — {verdict} the "
                 f"{env.get('envelope_factor')}x envelope."
+            )
+    return lines
+
+
+def _render_migration(migration: Dict[str, Any]) -> List[str]:
+    """The durable-sessions A/B (BENCH_serve_migration.json): per-event
+    outcome table per side and the window-reset verdict the snapshot
+    ring exists to win."""
+    lines = [""]
+    resets = migration.get("value", 0)
+    lines.append(
+        f"Durable sessions (BENCH_serve_migration.json): "
+        f"{resets} window reset(s) on the durable side vs "
+        f"{migration.get('legacy_window_resets', '?')} legacy, across "
+        f"{migration.get('fleet_replicas', '?')} stub replicas and the "
+        f"{'/'.join(migration.get('events', []))} gauntlet "
+        f"({migration.get('requests_failed', '?')} failed requests)."
+    )
+    lines.append(
+        "Continuations token-identical: "
+        + (
+            "yes"
+            if migration.get("token_identical_continuations")
+            else "NO"
+        )
+        + "; compile pinned at bucket count: "
+        + (
+            "yes"
+            if migration.get("compile_pinned_at_bucket_count")
+            else "NO"
+        )
+        + "."
+    )
+    sides = migration.get("sides") or {}
+    for side in ("durable", "legacy"):
+        rec = sides.get(side) or {}
+        rows = [
+            r
+            for r in rec.get("events", [])
+            if r.get("event") in (migration.get("events") or [])
+        ]
+        if not rows:
+            continue
+        lines.append("")
+        lines.append(
+            f"{'[' + side + ']':<12}{'event':<16}{'ok':>6}{'migr':>6}"
+            f"{'rest':>6}{'rej':>6}{'fail':>6}{'resets':>8}"
+        )
+        for row in rows:
+            lines.append(
+                f"{'':<12}{row.get('event', '?'):<16}"
+                f"{row.get('ok', 0):>6}"
+                f"{row.get('migrated', 0):>6}"
+                f"{row.get('restarted', 0):>6}"
+                f"{row.get('rejected', 0):>6}"
+                f"{row.get('failed', 0):>6}"
+                f"{row.get('window_resets', 0):>8}"
+            )
+        counters = rec.get("migration_counters") or {}
+        if counters:
+            lines.append(
+                f"  exports {counters.get('migration_exports_total', 0)}, "
+                f"imports {counters.get('migration_imports_total', 0)} "
+                f"({counters.get('migration_import_failures_total', 0)} "
+                f"failed), ring restores "
+                f"{counters.get('migration_restores_total', 0)} "
+                f"({counters.get('migration_restore_failures_total', 0)} "
+                f"failed)."
             )
     return lines
 

@@ -31,6 +31,11 @@ Every request lands in exactly one outcome class, each with its own
 latency percentiles in the output:
 
 * ``ok``         — 200
+* ``migrated``   — 200 carrying ``"migrated": true``: the session's
+                   replica was drained/reloaded/killed but its window
+                   moved intact (live migration or a snapshot-ring
+                   restore) — continuity, not degradation; an SLO-good
+                   class.
 * ``restarted``  — 200 carrying ``"restarted": true``: the session's
                    replica died and the router re-homed it (fresh context
                    window). Bounded, honest degradation — not an error.
@@ -88,7 +93,7 @@ INSTRUCTION_POOL = (
     "separate the red moon from the blue cube",
 )
 
-OUTCOME_CLASSES = ("ok", "restarted", "rejected", "failed")
+OUTCOME_CLASSES = ("ok", "migrated", "restarted", "rejected", "failed")
 
 
 def _post(
@@ -262,7 +267,14 @@ def _session_worker(
             break
         elapsed = time.perf_counter() - t0
         if status == 200 and "action" in body:
-            klass = "restarted" if body.get("restarted") else "ok"
+            # Precedence mirrors the router's booking: a migrated flag
+            # means the event happened AND the window survived it.
+            if body.get("migrated"):
+                klass = "migrated"
+            elif body.get("restarted"):
+                klass = "restarted"
+            else:
+                klass = "ok"
             if traced and (
                 body.get("request_id") != rid
                 or (body.get("phases") or {}).get("request_id") != rid
@@ -362,7 +374,9 @@ def run_loadgen(
         )
         for klass in OUTCOME_CLASSES
     }
-    answered = sorted(by_class["ok"] + by_class["restarted"])
+    answered = sorted(
+        by_class["ok"] + by_class["migrated"] + by_class["restarted"]
+    )
     busy = sum(result["busy"] for result in out.values())
     rid_mismatches = sum(
         result.get("rid_mismatches", 0) for result in out.values()
@@ -387,6 +401,7 @@ def run_loadgen(
         "duration_s": round(duration_s, 3) if duration_s > 0 else None,
         "think_time_s": think_time_s,
         "requests_ok": len(by_class["ok"]),
+        "requests_migrated": len(by_class["migrated"]),
         "requests_restarted": len(by_class["restarted"]),
         "requests_rejected": len(by_class["rejected"]),
         "requests_failed": len(by_class["failed"]),
@@ -1084,6 +1099,9 @@ def run_fleet_chaos(args) -> dict:
             "fleet_replicas": args.fleet,
             "faults": args.faults,
             "chaos_interval_s": args.chaos_interval_s,
+            "sessions_migrated_total": router_metrics.get(
+                "sessions_migrated_total"
+            ),
             "sessions_restarted_total": router_metrics.get(
                 "sessions_restarted_total"
             ),
@@ -1247,6 +1265,7 @@ def _run_schedule_phases(args, url: str, schedule: str) -> list:
                 "latency_p50_ms": run["latency_p50_ms"],
                 "latency_p99_ms": run["latency_p99_ms"],
                 "requests_ok": run["requests_ok"],
+                "requests_migrated": run["requests_migrated"],
                 "requests_restarted": run["requests_restarted"],
                 "requests_rejected": run["requests_rejected"],
                 "requests_failed": run["requests_failed"],
@@ -1315,7 +1334,10 @@ def run_elastic_bench(args) -> dict:
             wall = time.perf_counter() - t0
             autoscale = final.get("autoscale") or {}
             answered = sum(
-                r["requests_ok"] + r["requests_restarted"] for r in rows
+                r["requests_ok"]
+                + r["requests_migrated"]
+                + r["requests_restarted"]
+                for r in rows
             )
             cost_units = autoscale.get("cost_units")
             # The pinned-compile invariant across every replica LIFETIME:
@@ -1350,6 +1372,9 @@ def run_elastic_bench(args) -> dict:
                 "phases": rows,
                 "wall_s": round(wall, 3),
                 "requests_ok": sum(r["requests_ok"] for r in rows),
+                "requests_migrated": sum(
+                    r["requests_migrated"] for r in rows
+                ),
                 "requests_restarted": sum(
                     r["requests_restarted"] for r in rows
                 ),
@@ -1462,6 +1487,308 @@ def run_elastic_bench(args) -> dict:
                 if args.stub
                 else ""
             )
+        ),
+    }
+
+
+# -------------------------------------------------------------- migration
+
+
+#: The four disruption events the migration A/B drives, in order. Each is
+#: followed by one act on every session to classify the continuation.
+MIGRATION_EVENTS = ("kill", "drain", "rolling_reload", "rebalance")
+
+
+def _migration_fleet_cmd(args, snapshot_dir: str) -> list:
+    """Fleet argv for one migration-A/B side: stub replicas, the kill
+    fault armed on the chaos clock, durable sessions iff `snapshot_dir`
+    is set (the only difference between the two sides)."""
+    cmd = [
+        sys.executable, "-m", "rt1_tpu.serve.fleet",
+        "--replicas", str(args.fleet or 3),
+        "--port", "0",
+        "--max_sessions", str(args.max_sessions),
+        "--replica_timeout_s", str(args.replica_timeout_s),
+        "--chaos_interval_s", str(args.chaos_interval_s),
+        "--faults", args.faults or "replica_kill@1",
+        "--slo_availability", str(args.slo_availability),
+        "--slo_p50_ms", str(args.slo_p50_ms),
+        "--slo_p99_ms", str(args.slo_p99_ms),
+        "--stub",
+    ]
+    if snapshot_dir:
+        cmd += ["--session_snapshot_dir", snapshot_dir]
+    if args.log_dir:
+        cmd += ["--log_dir", args.log_dir]
+    return cmd
+
+
+def _drive_migration_side(args, durable: bool) -> dict:
+    """Boot one fleet, walk it through every MIGRATION_EVENTS disruption,
+    and classify each session's continuation after each event.
+
+    Continuity is judged by ``step_index``, not by flags: the stub serves
+    step N iff the window survived N prior acts, so a response whose
+    step_index fell below the client's own count is a window reset no
+    matter what the body claims. In stub mode the action values are also
+    checked against the stub's deterministic per-step function — the
+    token-identical-continuation bar, over real HTTP."""
+    import shutil
+    import tempfile
+
+    from rt1_tpu.serve.stub import stub_action
+
+    snapshot_dir = tempfile.mkdtemp(prefix="rt1-migration-ab-")
+    timeout = args.timeout
+    fleet_n = args.fleet or 3
+    proc, url, _ready = _spawn_fleet(
+        _migration_fleet_cmd(args, snapshot_dir if durable else ""),
+        args.fleet_warmup_timeout_s,
+    )
+    sessions: dict = {}  # sid -> acts completed (== next expected step)
+    homes: dict = {}
+    events = []
+    token_checks = token_matches = 0
+    final_line: dict = {}
+
+    def _act(sid: str) -> tuple:
+        payload = {
+            "session_id": sid,
+            "image_b64": "AAAA",
+            "instruction": INSTRUCTION_POOL[0],
+        }
+        retries = 0
+        while True:
+            status, body = _post(url + "/act", payload, timeout)
+            if (
+                status == 503
+                and body.get("retry")
+                and retries < args.max_retries
+            ):
+                retries += 1
+                time.sleep(0.02)
+                continue
+            return status, body
+
+    def _sweep(label: str) -> dict:
+        nonlocal token_checks, token_matches
+        row = {"event": label}
+        row.update({k: 0 for k in OUTCOME_CLASSES})
+        row["window_resets"] = 0
+        row["continuity_ok"] = 0
+        for sid in sorted(sessions):
+            expected = sessions[sid]
+            status, body = _act(sid)
+            if status == 200 and "action" in body:
+                if body.get("migrated"):
+                    row["migrated"] += 1
+                elif body.get("restarted"):
+                    row["restarted"] += 1
+                else:
+                    row["ok"] += 1
+                served = body.get("step_index")
+                if served == expected:
+                    row["continuity_ok"] += 1
+                    token_checks += 1
+                    if body.get("action") == stub_action(expected):
+                        token_matches += 1
+                elif isinstance(served, int) and served < expected:
+                    # The window came back shorter than the client's own
+                    # history: a reset, whatever the flags said.
+                    row["window_resets"] += 1
+                sessions[sid] = (
+                    served + 1 if isinstance(served, int) else expected + 1
+                )
+                homes[sid] = body.get("replica_id")
+            elif status in (429, 503):
+                row["rejected"] += 1
+            else:
+                row["failed"] += 1
+        events.append(row)
+        return row
+
+    def _fleet_status() -> dict:
+        try:
+            return _get(url + "/fleet/status", timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            return {}
+
+    def _wait(predicate, timeout_s: float, what: str) -> bool:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.05)
+        events.append({"event": f"timeout:{what}"})
+        return False
+
+    try:
+        # Baseline: open the sessions and advance every window a few
+        # steps, so each later continuation has history to preserve.
+        for i in range(args.sessions):
+            sid = f"mig-{i}"
+            _post(url + "/reset", {"session_id": sid}, timeout)
+            sessions[sid] = 0
+        for _ in range(max(args.steps, 1)):
+            _sweep("warmup")
+
+        # Event 1 — SIGKILL (the chaos scheduler's replica_kill): act
+        # through the dead window so the router notices the death and
+        # re-homes; durable side restores from the shared snapshot ring.
+        killed = _wait(
+            lambda: _fleet_status().get("replica_restarts_total", 0) >= 1
+            or _fleet_status().get("replicas_ready", fleet_n) < fleet_n,
+            30.0,
+            "replica_kill to fire",
+        )
+        kill_row = _sweep("kill")
+        kill_row["kill_observed"] = killed
+        _wait(
+            lambda: _fleet_status().get("replicas_ready") == fleet_n,
+            args.fleet_warmup_timeout_s,
+            "fleet to heal after the kill",
+        )
+
+        # Event 2 — elastic drain: POST /scale_down reclaims one replica
+        # through the supervisor's migrating drain.
+        status, body = _post(url + "/scale_down", {}, timeout)
+        drained_ok = status == 200 and body.get("ok")
+        _wait(
+            lambda: _fleet_status().get("replicas_total") == fleet_n - 1,
+            30.0,
+            "the drain to finish",
+        )
+        drain_row = _sweep("drain")
+        drain_row["scale_down_ok"] = bool(drained_ok)
+
+        # Event 3 — rolling checkpoint reload (a new generation: old
+        # snapshots become import-refusable, in-place swaps preserve).
+        status, body = _post(url + "/reload", {"step": 2}, timeout)
+        reload_row = _sweep("rolling_reload")
+        reload_row["reload_ok"] = status == 200 and bool(body.get("ok"))
+
+        # Event 4 — rebalance: migrate the hottest sessions off the
+        # most-loaded survivor.
+        counts: dict = {}
+        for rid in homes.values():
+            counts[rid] = counts.get(rid, 0) + 1
+        hot = max(counts, key=counts.get) if counts else 0
+        status, body = _post(
+            url + "/rebalance",
+            {"replica_id": int(hot), "count": args.rebalance_count},
+            timeout,
+        )
+        rebalance_row = _sweep("rebalance")
+        rebalance_row["rebalance_ok"] = status == 200
+        rebalance_row["rebalance_migrated"] = (body or {}).get("migrated")
+
+        router_metrics = _get(url + "/metrics", timeout)
+        fleet_status = _fleet_status()
+    finally:
+        final_line = _stop_fleet(proc, timeout=60)
+        shutil.rmtree(snapshot_dir, ignore_errors=True)
+
+    totals = {k: sum(r.get(k, 0) for r in events) for k in OUTCOME_CLASSES}
+    compile_pairs = [
+        (
+            (r.get("metrics") or {}).get("compile_count"),
+            (r.get("metrics") or {}).get("bucket_count"),
+        )
+        for r in fleet_status.get("replicas", [])
+    ]
+    migration_counters = {
+        key: sum(
+            (rep or {}).get(key) or 0
+            for rep in (router_metrics.get("replicas") or {}).values()
+        )
+        for key in (
+            "migration_exports_total",
+            "migration_imports_total",
+            "migration_import_failures_total",
+            "migration_restores_total",
+            "migration_restore_failures_total",
+        )
+    }
+    return {
+        "durable": durable,
+        "events": events,
+        "requests_ok": totals["ok"],
+        "requests_migrated": totals["migrated"],
+        "requests_restarted": totals["restarted"],
+        "requests_rejected": totals["rejected"],
+        "requests_failed": totals["failed"],
+        "window_resets": sum(r.get("window_resets", 0) for r in events),
+        "continuity_ok": sum(r.get("continuity_ok", 0) for r in events),
+        "token_checks": token_checks,
+        "token_matches": token_matches,
+        "sessions_migrated_total": router_metrics.get(
+            "sessions_migrated_total"
+        ),
+        "sessions_restarted_total": router_metrics.get(
+            "sessions_restarted_total"
+        ),
+        "migration_counters": migration_counters,
+        "replica_compile_counts": compile_pairs,
+        "compile_pinned_at_bucket_count": bool(compile_pairs)
+        and all(
+            c == b and (b or 0) >= 1
+            for c, b in compile_pairs
+            if c is not None or b is not None
+        ),
+        "server_slo": final_line.get("slo"),
+        "chaos": final_line.get("chaos"),
+    }
+
+
+def run_migration_ab(args) -> dict:
+    """Durable-sessions A/B (the tentpole acceptance run): the identical
+    disruption gauntlet — SIGKILL, elastic drain, rolling reload,
+    rebalance — against a stub fleet with the snapshot ring armed vs the
+    legacy (no crash durability) fleet.
+
+    The acceptance shape: the durable side books every disruption-
+    affected continuation ``migrated`` (0 restarted, 0 window resets, 0
+    failed, token-identical continuations), while the legacy side's
+    SIGKILL produces the old ``restarted`` window resets — the delta the
+    feature erases. Writes ``BENCH_serve_migration.json`` via --output."""
+    sides = {
+        "durable": _drive_migration_side(args, durable=True),
+        "legacy": _drive_migration_side(args, durable=False),
+    }
+    durable = sides["durable"]
+    return {
+        "metric": "serve_migration_window_resets",
+        "value": durable["window_resets"],
+        "unit": "resets",
+        "fleet_replicas": args.fleet or 3,
+        "sessions": args.sessions,
+        "warmup_steps": max(args.steps, 1),
+        "events": list(MIGRATION_EVENTS),
+        "faults": args.faults or "replica_kill@1",
+        "zero_window_resets": durable["window_resets"] == 0
+        and durable["requests_restarted"] == 0,
+        "legacy_window_resets": sides["legacy"]["window_resets"],
+        "token_identical_continuations": (
+            durable["token_checks"] > 0
+            and durable["token_matches"] == durable["token_checks"]
+        ),
+        "requests_failed": sum(
+            s["requests_failed"] for s in sides.values()
+        ),
+        "compile_pinned_at_bucket_count": all(
+            s["compile_pinned_at_bucket_count"] for s in sides.values()
+        ),
+        "sides": sides,
+        "stub": True,
+        "timing_methodology": (
+            "two freshly-booted stub fleets run the identical disruption "
+            "sequence (chaos replica_kill, POST /scale_down drain, "
+            "POST /reload rolling reload, POST /rebalance), one act per "
+            "session after each event; the ONLY config delta is "
+            "--session_snapshot_dir on the durable side. Continuity is "
+            "judged by step_index (the stub serves step N iff the window "
+            "survived N acts) and by per-step action equality against "
+            "the stub's deterministic function — flags alone could lie"
         ),
     }
 
@@ -1638,6 +1965,16 @@ def main() -> int:
         help="[traffic_schedule] elastic peak-phase p99 must stay within "
              "this factor of the fixed-max fleet's.")
     parser.add_argument(
+        "--migration_ab", action="store_true",
+        help="Durable-sessions A/B (stub fleets): the same disruption "
+             "gauntlet (chaos kill, /scale_down drain, rolling /reload, "
+             "/rebalance) with and without the session snapshot ring; "
+             "writes BENCH_serve_migration.json via --output. Uses "
+             "--fleet (default 3), --sessions, --steps warmup acts.")
+    parser.add_argument(
+        "--rebalance_count", type=int, default=2,
+        help="[migration_ab] hottest sessions to move per /rebalance.")
+    parser.add_argument(
         "--quant_ab", default="",
         help="Per-dtype serving A/B: comma dtypes (e.g. 'f32,bf16,int8'); "
              "boots one random-init replica per dtype with --config, "
@@ -1682,6 +2019,8 @@ def main() -> int:
             result = run_elastic_bench(args)
         except ValueError as exc:
             parser.error(str(exc))
+    elif args.migration_ab:
+        result = run_migration_ab(args)
     elif args.occupancy_sweep:
         if not args.config:
             parser.error("--occupancy_sweep needs --config")

@@ -429,6 +429,57 @@ assert sled18.windowed_burn(60.0) > 0
 _sclock18["t"] += 120.0
 assert sled18.windowed_burn(60.0) == 0.0
 
+# ISSUE 19 durable sessions: the migration module runs inside the
+# model-free router/supervisor process (live migration over HTTP) and
+# the stub replica (jax-free snapshots) — stdlib-only by contract, and
+# the two new metric family groups render through the same paths.
+from rt1_tpu.serve import migrate as migrate19
+
+snap19 = {
+    "version": migrate19.SNAPSHOT_VERSION,
+    "session_id": "probe",
+    "step_index": 3,
+    "checkpoint_generation": -1,
+    "window": 6,
+    "cached_inference": False,
+    "schema": [["stub_step", [], "int64"]],
+    "state": {"stub_step": {"data": [3]}},
+}
+migrate19.check_compatibility(
+    snap19, checkpoint_generation=-1, window=6, cached_inference=False,
+    schema=[("stub_step", (), "int64")])
+try:
+    migrate19.check_compatibility(snap19, checkpoint_generation=7)
+except migrate19.SnapshotCompatibilityError as exc:
+    assert "checkpoint_generation" in str(exc)
+else:
+    raise AssertionError("generation mismatch must refuse by name")
+assert migrate19.decode_state(snap19["state"])["stub_step"] == [3]
+_rt19 = migrate19.decode_state(migrate19.encode_state({"w": [1.0, 2.0]}))
+assert list(_rt19["w"]) == [1.0, 2.0]
+with _tempfile.TemporaryDirectory() as _ringd:
+    ring19 = migrate19.SnapshotRing(_ringd, capacity=2)
+    ring19.save(snap19)
+    rec19, age19 = ring19.load("probe")
+    assert rec19["step_index"] == 3 and age19 >= 0.0
+
+# The stub speaks the full export/import contract jax-free, and the
+# migration counter families render only once armed (or nonzero).
+stub19 = StubReplicaApp(replica_id=3)
+assert "migration_exports_total" not in stub19.metrics_snapshot()
+stub19.act({"session_id": "mig", "image_b64": "AAAA"})
+_code19, _body19 = stub19.session_export({"session_id": "mig"})
+assert _code19 == 200 and _body19["snapshot"]["step_index"] == 1
+stub19b = StubReplicaApp(replica_id=4)
+_code19, _imp19 = stub19b.session_import(
+    {"snapshot": _body19["snapshot"]})
+assert _code19 == 200 and _imp19["step_index"] == 1
+assert stub19b.metrics_snapshot()["migration_imports_total"] == 1
+mig_text = ServeMetrics().prometheus_text(migration_imports_total=2)
+assert "# TYPE rt1_serve_migration_imports_total counter" in mig_text
+assert "rt1_serve_replica_migration_imports_total" in fleet_metric_names()
+assert "rt1_serve_replica_migration_restores_total" in fleet_metric_names()
+
 offenders = [m for m in sys.modules if m.split(".")[0] in BLOCKED]
 assert not offenders, f"training deps leaked into the import: {offenders}"
 print("OK")

@@ -124,7 +124,7 @@ def test_replica_death_attributes_final_outcome_to_survivor(fleet):
     snap = router.replica_slo_snapshot()
     assert snap[0]["outcomes"]["restarted"] == 1
     assert snap[1]["outcomes"] == {
-        "ok": 1, "restarted": 0, "rejected": 0, "failed": 0
+        "ok": 1, "migrated": 0, "restarted": 0, "rejected": 0, "failed": 0
     }
 
 

@@ -417,6 +417,100 @@ def test_serve_elastic_bench_renders_timeline_and_cost(tmp_path):
     assert "Elastic fleet" not in bare
 
 
+def test_serve_migration_bench_renders_event_table(tmp_path):
+    """ISSUE 19: a BENCH_serve_migration.json in the workdir renders as
+    the per-event durable-vs-legacy outcome table with the window-reset
+    verdict and migration counters; a workdir without one keeps its
+    report migration-free."""
+    wd = _canned_serve_workdir(tmp_path)
+    migration = {
+        "metric": "serve_migration_window_resets",
+        "value": 0,
+        "unit": "resets",
+        "fleet_replicas": 3,
+        "events": ["kill", "drain", "rolling_reload", "rebalance"],
+        "zero_window_resets": True,
+        "legacy_window_resets": 3,
+        "token_identical_continuations": True,
+        "requests_failed": 0,
+        "compile_pinned_at_bucket_count": True,
+        "sides": {
+            "durable": {
+                "durable": True,
+                "events": [
+                    {"event": "warmup", "ok": 8, "migrated": 0,
+                     "restarted": 0, "rejected": 0, "failed": 0,
+                     "window_resets": 0, "continuity_ok": 8},
+                    {"event": "kill", "ok": 5, "migrated": 3,
+                     "restarted": 0, "rejected": 0, "failed": 0,
+                     "window_resets": 0, "continuity_ok": 8},
+                    {"event": "drain", "ok": 4, "migrated": 4,
+                     "restarted": 0, "rejected": 0, "failed": 0,
+                     "window_resets": 0, "continuity_ok": 8},
+                    {"event": "rolling_reload", "ok": 4, "migrated": 4,
+                     "restarted": 0, "rejected": 0, "failed": 0,
+                     "window_resets": 0, "continuity_ok": 8},
+                    {"event": "rebalance", "ok": 6, "migrated": 2,
+                     "restarted": 0, "rejected": 0, "failed": 0,
+                     "window_resets": 0, "continuity_ok": 8},
+                ],
+                "migration_counters": {
+                    "migration_exports_total": 6,
+                    "migration_imports_total": 10,
+                    "migration_import_failures_total": 0,
+                    "migration_restores_total": 1,
+                    "migration_restore_failures_total": 0,
+                },
+            },
+            "legacy": {
+                "durable": False,
+                "events": [
+                    {"event": "kill", "ok": 5, "migrated": 0,
+                     "restarted": 3, "rejected": 0, "failed": 0,
+                     "window_resets": 3, "continuity_ok": 5},
+                ],
+                "migration_counters": {
+                    "migration_exports_total": 6,
+                    "migration_imports_total": 10,
+                    "migration_import_failures_total": 0,
+                    "migration_restores_total": 0,
+                    "migration_restore_failures_total": 0,
+                },
+            },
+        },
+    }
+    with open(os.path.join(wd, "BENCH_serve_migration.json"), "w") as f:
+        json.dump(migration, f)
+    serve = run_report.load_serve(wd)
+    assert serve["migration_bench"]["value"] == 0
+    report = run_report.render_report(wd, None, None, None, serve=serve)
+    assert (
+        "0 window reset(s) on the durable side vs 3 legacy" in report
+    )
+    assert "kill/drain/rolling_reload/rebalance gauntlet" in report
+    assert "Continuations token-identical: yes" in report
+    assert "compile pinned at bucket count: yes" in report
+    lines = report.splitlines()
+    # Per-event rows for both sides — the warmup row stays out of the
+    # table (it is load, not a disruption).
+    durable_kill = next(
+        ln for ln in lines if "[durable]" in ln or (
+            ln.strip().startswith("kill") and "3" in ln
+        )
+    )
+    assert durable_kill is not None
+    kill_rows = [ln for ln in lines if ln.strip().startswith("kill ")]
+    assert len(kill_rows) == 2  # one per side
+    assert not any("warmup" in ln for ln in lines)
+    assert "ring restores 1 (0 failed)." in report
+    assert "ring restores 0 (0 failed)." in report
+    # A workdir without the record keeps its report migration-free.
+    bare = run_report.render_report(
+        wd, None, None, None, serve={"slo": serve["slo"]}
+    )
+    assert "Durable sessions" not in bare
+
+
 def test_eval_matrix_section_renders_table(tmp_path):
     """ISSUE 13: a BENCH_eval_matrix.json in the workdir renders as a
     task × checkpoint success table (plus the oracle-fill note); a

@@ -364,9 +364,10 @@ def _wait_until(predicate, timeout_s, what):
 def test_elastic_scale_up_down_cycle(elastic_fleet):
     """The tentpole acceptance on stubs: ramp → 1→3 with int8 surge
     replicas, drop → drain back to 1 with 0 failed requests, sessions on
-    reclaimed replicas re-home (restarted flag, fresh window), reaped ids
-    purged from /metrics (JSON + text) and /fleet/status — and the
-    rt1_serve_autoscale_* families tell the story on the same scrape."""
+    reclaimed replicas live-migrate to the survivor (migrated flag,
+    window intact — NOT a restart), reaped ids purged from /metrics
+    (JSON + text) and /fleet/status — and the rt1_serve_autoscale_*
+    families tell the story on the same scrape."""
     router, supervisor, url = elastic_fleet
     statuses = []
     statuses_lock = threading.Lock()
@@ -434,24 +435,34 @@ def test_elastic_scale_up_down_cycle(elastic_fleet):
         assert event["exit_code"] == 0
         assert event["compile_count"] == event["bucket_count"] == 1
 
-    # In-flight sessions re-home through the existing failover path:
-    # wave-2 sessions lived on reclaimed surge replicas — their next act
-    # is a 200 with restarted:true and a fresh window, never a 5xx.
-    rehomed = 0
+    # Durable sessions: the drain live-migrated wave-2 sessions off the
+    # reclaimed surge replicas — their next act is a 200 with
+    # migrated:true and the WINDOW INTACT (each acted once pre-drain, so
+    # the continuation serves step 1, not a fresh step 0). Never a 5xx,
+    # and never a silent context reset.
+    migrated = 0
     for sid, home in wave2_home.items():
         status, body = _act(url, sid)
         assert status == 200, body
         assert body["replica_id"] == 0
         if home != 0:
-            assert body["restarted"] is True
-            assert body["step_index"] == 0
-            rehomed += 1
-    assert rehomed >= 1
+            assert body.get("migrated") is True
+            assert "restarted" not in body
+            assert body["step_index"] == 1  # continuity, not reset
+            migrated += 1
+    assert migrated >= 1
 
     # Ghost purge (satellite): reaped ids are gone from every surface —
     # dropped, not zeroed.
     status, fleet_status = _get(url + "/fleet/status")
     assert [r["id"] for r in fleet_status["replicas"]] == [0]
+    # The fleet-shape gauge refreshes on the first autoscale tick after
+    # the reclaim thread retires, so give it a beat to settle.
+    _wait_until(
+        lambda: _get(url + "/metrics")[1].get("autoscale_replicas") == 1,
+        10.0,
+        "autoscale gauge to settle at 1",
+    )
     status, metrics = _get(url + "/metrics")
     assert set(metrics["replicas"].keys()) == {"0"}
     assert metrics["autoscale_replicas"] == 1

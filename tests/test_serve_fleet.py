@@ -327,7 +327,9 @@ def test_slo_endpoint_and_fleet_slow_requests(fleet):
     status, slo = _get(url + "/slo")
     assert status == 200
     assert slo["requests_total"] > 0
-    assert set(slo["by_class"]) == {"ok", "restarted", "rejected", "failed"}
+    assert set(slo["by_class"]) == {
+        "ok", "migrated", "restarted", "rejected", "failed",
+    }
     assert "error_budget_burn" in slo
     status, body = _get(url + "/fleet/slow_requests")
     assert status == 200

@@ -1,0 +1,548 @@
+"""Durable sessions (ISSUE 19): snapshot, replicate, and live-migrate
+session state so no event resets a user's window.
+
+In-process stub replicas + a Router instance, no subprocesses: tier-1
+fast. The stub implements the exact wire contract of the real replica
+(`rt1_tpu/serve/migrate.py` + `/session/export` + `/session/import`),
+so these tests prove live migration, affinity remap, crash restore,
+compatibility refusals, and the failed-import fallback with zero jax
+boots.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rt1_tpu.obs import prometheus as prom
+from rt1_tpu.obs.alerts import default_ruleset
+from rt1_tpu.resilience import faults
+from rt1_tpu.serve import migrate
+from rt1_tpu.serve.metrics import ServeMetrics
+from rt1_tpu.serve.router import READY, Replica, Router, make_router_server
+from rt1_tpu.serve.stub import (
+    STUB_SCHEMA,
+    STUB_WINDOW,
+    StubReplicaApp,
+    make_stub_server,
+    stub_action,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _wire_snapshot(sid="s", step=3, generation=-1, window=STUB_WINDOW,
+                   cached=False, version=migrate.SNAPSHOT_VERSION):
+    """A stub-shaped snapshot, field-for-field what /session/export ships."""
+    return {
+        "version": version,
+        "session_id": sid,
+        "step_index": step,
+        "checkpoint_generation": generation,
+        "window": window,
+        "cached_inference": cached,
+        "schema": [[n, list(s), d] for n, s, d in STUB_SCHEMA],
+        "state": {"stub_step": {"data": [step]}},
+    }
+
+
+def _post(url, payload, timeout=5.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+# ---------------------------------------------------------------- migrate.py
+
+
+def test_encode_decode_state_roundtrip():
+    state = {"w": [1.0, 2.0, -3.5], "b": [[0.5, 0.25]]}
+    encoded = migrate.encode_state(state)
+    for leaf in encoded.values():
+        assert set(leaf) >= {"shape", "dtype", "b64"}
+    decoded = migrate.decode_state(encoded)
+    assert decoded["w"].tolist() == [1.0, 2.0, -3.5]
+    assert decoded["b"].tolist() == [[0.5, 0.25]]
+    # Jax-free stubs ship raw-list leaves; decode passes them through.
+    assert migrate.decode_state({"s": {"data": [7]}})["s"] == [7]
+
+
+def test_check_compatibility_refuses_by_named_field():
+    snap = _wire_snapshot(generation=100)
+    kwargs = dict(
+        checkpoint_generation=100,
+        window=STUB_WINDOW,
+        cached_inference=False,
+        schema=STUB_SCHEMA,
+    )
+    migrate.check_compatibility(snap, **kwargs)  # compatible: no raise
+    for field, mutate in [
+        ("version", {"version": migrate.SNAPSHOT_VERSION + 1}),
+        ("checkpoint_generation", {"checkpoint_generation": 99}),
+        ("window", {"window": STUB_WINDOW + 1}),
+        ("cached_inference", {"cached_inference": True}),
+    ]:
+        with pytest.raises(migrate.SnapshotCompatibilityError) as exc:
+            migrate.check_compatibility({**snap, **mutate}, **kwargs)
+        assert field in str(exc.value), field
+    # Schema skew is refused too — a leaf the importer doesn't expect.
+    bad = dict(snap)
+    bad["schema"] = [["other_leaf", [], "int64"]]
+    with pytest.raises(migrate.SnapshotCompatibilityError) as exc:
+        migrate.check_compatibility(bad, **kwargs)
+    assert "schema" in str(exc.value)
+
+
+def test_snapshot_ring_roundtrip_eviction_and_drop(tmp_path):
+    ring = migrate.SnapshotRing(str(tmp_path), capacity=2)
+    with pytest.raises(ValueError):
+        ring.save({"step_index": 1})  # no session_id
+    for i, sid in enumerate(["old", "mid", "new"]):
+        ring.save(_wire_snapshot(sid=sid, step=i))
+        time.sleep(0.05)  # distinct mtimes: eviction is oldest-by-mtime
+    assert len(ring) == 2
+    assert ring.evictions == 1
+    assert ring.load("old") is None  # oldest evicted
+    loaded = ring.load("new")
+    assert loaded is not None
+    record, age_s = loaded
+    assert record["step_index"] == 2
+    assert age_s is not None and age_s >= 0.0
+    assert "saved_at" in record  # stamped on save
+    ring.drop("new")
+    assert ring.load("new") is None
+    assert len(ring) == 1
+    ring.drop("never-saved")  # best-effort: no raise
+
+
+def test_snapshot_ring_survives_corrupt_record(tmp_path):
+    ring = migrate.SnapshotRing(str(tmp_path))
+    path = ring.save(_wire_snapshot(sid="torn"))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("{not json")
+    assert ring.load("torn") is None  # corrupt = miss, not crash
+
+
+def test_migrate_session_never_raises_on_dead_source():
+    result = migrate.migrate_session(
+        "http://127.0.0.1:1", "http://127.0.0.1:1", "ghost", timeout_s=0.2
+    )
+    assert result["ok"] is False
+    assert result["stage"] in ("export", "transport")
+    assert result["error"]
+
+
+# --------------------------------------------------------- stub wire contract
+
+
+def test_stub_export_import_token_identical_continuation():
+    src = StubReplicaApp(replica_id=0)
+    dst = StubReplicaApp(replica_id=1)
+    for _ in range(3):
+        code, _ = src.act({"session_id": "mig", "image_b64": "AAAA"})
+        assert code == 200
+    code, body = src.session_export({"session_id": "mig"})
+    assert code == 200 and body["ok"] is True
+    snapshot = body["snapshot"]
+    assert snapshot["step_index"] == 3
+    assert snapshot["version"] == migrate.SNAPSHOT_VERSION
+    # The continuation the user would have seen had nothing moved.
+    code, ref = src.act({"session_id": "mig", "image_b64": "AAAA"})
+    assert code == 200 and ref["step_index"] == 3
+
+    code, imported = dst.session_import({"snapshot": snapshot})
+    assert code == 200
+    assert imported["session_id"] == "mig"
+    assert imported["step_index"] == 3
+    code, cont = dst.act({"session_id": "mig", "image_b64": "AAAA"})
+    assert code == 200
+    # Token-identical: same step, same action, same tokens, no restart.
+    assert cont["step_index"] == ref["step_index"] == 3
+    assert cont["action"] == ref["action"] == stub_action(3)
+    assert cont["action_tokens"] == ref["action_tokens"]
+    assert cont["session_started"] is False
+    assert src.migration_exports == 1
+    assert dst.migration_imports == 1
+
+
+def test_stub_import_refusals_named_over_http():
+    app = StubReplicaApp(replica_id=0)
+    code, body = app.session_import({})
+    assert code == 400  # no snapshot at all
+    snap = _wire_snapshot(sid="x", generation=-1)
+    for field, mutate in [
+        ("checkpoint_generation", {"checkpoint_generation": 7}),
+        ("window", {"window": STUB_WINDOW - 1}),
+        ("cached_inference", {"cached_inference": True}),
+    ]:
+        code, body = app.session_import({"snapshot": {**snap, **mutate}})
+        assert code == 409, field
+        assert field in body["error"], field
+    assert app.migration_import_failures == 3
+    # Unknown-session export is a 404, not an invented snapshot.
+    code, body = app.session_export({"session_id": "never-opened"})
+    assert code == 404
+
+
+def test_stub_ring_restore_after_respawn(tmp_path):
+    """SIGKILL durability, mimicked: a fresh process sharing the snapshot
+    directory resumes the window mid-episode at re-home time."""
+    first = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    for _ in range(2):
+        code, _ = first.act({"session_id": "dur", "image_b64": "AAAA"})
+        assert code == 200
+    # "Respawn": a new app over the same directory, empty session table.
+    second = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    code, body = second.act({"session_id": "dur", "image_b64": "AAAA"})
+    assert code == 200
+    assert body["session_restored"] is True
+    assert body["step_index_restored"] == 2
+    assert body["step_index"] == 2  # continues, not restarts
+    assert body["action"] == stub_action(2)
+    assert body["session_started"] is False
+    assert second.migration_restores == 1
+
+
+def test_stub_ring_restore_staleness_bound(tmp_path):
+    first = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    code, _ = first.act({"session_id": "stale", "image_b64": "AAAA"})
+    assert code == 200
+    second = StubReplicaApp(
+        replica_id=0,
+        session_snapshot_dir=str(tmp_path),
+        snapshot_max_age_s=0.01,
+    )
+    time.sleep(0.05)
+    code, body = second.act({"session_id": "stale", "image_b64": "AAAA"})
+    assert code == 200  # degrades to a fresh window, never an error
+    assert "session_restored" not in body
+    assert body["step_index"] == 0 and body["session_started"] is True
+    assert second.migration_restore_failures == 1
+    # The stale record was dropped, then the fresh act re-saved the new
+    # window: the ring now holds step 1, not the step-1-of-old-life junk.
+    record, _age = second.snapshot_ring.load("stale")
+    assert record["step_index"] == 1
+
+
+def test_stub_ring_restore_fault_degrades_to_fresh_window(tmp_path):
+    first = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    code, _ = first.act({"session_id": "chaos", "image_b64": "AAAA"})
+    assert code == 200
+    faults.install(faults.FaultPlan.parse("session_restore@1"))
+    second = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    code, body = second.act({"session_id": "chaos", "image_b64": "AAAA"})
+    assert code == 200
+    assert "session_restored" not in body
+    assert body["step_index"] == 0
+    assert second.migration_restore_failures == 1
+
+
+def test_release_keep_snapshot_preserves_ring_entry(tmp_path):
+    """Migration cleanup releases the source's stale copy WITHOUT
+    dropping the shared ring file — it now backs the importer's session,
+    whose crash durability must not lapse until its next act."""
+    app = StubReplicaApp(replica_id=0, session_snapshot_dir=str(tmp_path))
+    code, _ = app.act({"session_id": "moved", "image_b64": "AAAA"})
+    assert code == 200
+    code, _ = app.release({"session_id": "moved", "keep_snapshot": True})
+    assert code == 200
+    assert "moved" not in app._sessions
+    record, _age = app.snapshot_ring.load("moved")
+    assert record["step_index"] == 1
+    # A plain client release still drops it (forget-me semantics).
+    code, _ = app.act({"session_id": "gone", "image_b64": "AAAA"})
+    assert code == 200
+    code, _ = app.release({"session_id": "gone"})
+    assert code == 200
+    assert app.snapshot_ring.load("gone") is None
+
+
+def test_stub_reload_bumps_generation_and_preserves_sessions():
+    app = StubReplicaApp(replica_id=0)
+    code, _ = app.act({"session_id": "live", "image_b64": "AAAA"})
+    assert code == 200
+    code, _ = app.reload({"step": 42})
+    assert code == 200
+    assert app.checkpoint_generation == 42
+    # In-place hot-swap preserves the window...
+    code, body = app.act({"session_id": "live", "image_b64": "AAAA"})
+    assert code == 200 and body["step_index"] == 1
+    # ...while imports of pre-reload snapshots are refused by name.
+    code, body = app.session_import(
+        {"snapshot": _wire_snapshot(sid="old-gen", generation=-1)}
+    )
+    assert code == 409 and "checkpoint_generation" in body["error"]
+
+
+# ------------------------------------------------------------- live migration
+
+
+@pytest.fixture()
+def fleet():
+    apps, servers = [], []
+    router = Router(replica_timeout_s=5.0)
+    for rid in range(2):
+        app = StubReplicaApp(replica_id=rid)
+        httpd = make_stub_server(app)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        host, port = httpd.server_address[:2]
+        replica = router.add_replica(Replica(rid, url=f"http://{host}:{port}"))
+        replica.state = READY
+        apps.append(app)
+        servers.append(httpd)
+    yield router, apps, servers
+    for httpd in servers:
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except OSError:
+            pass
+
+
+def _act(router, session_id):
+    return router.route_act({"session_id": session_id, "image_b64": "AAAA"})
+
+
+def test_router_migrates_drain_victims_with_token_identity(fleet):
+    router, apps, _ = fleet
+    # Least-loaded placement, lower-id tiebreak: "a" -> 0, "b" -> 1.
+    for _ in range(3):
+        status, body = _act(router, "a")
+        assert status == 200 and body["replica_id"] == 0
+    status, body = _act(router, "b")
+    assert status == 200 and body["replica_id"] == 1
+
+    summary = router.migrate_sessions_from(0, reason="drain")
+    assert summary["migrated"] == 1 and summary["failed"] == 0
+    assert summary["sessions"][0]["session_id"] == "a"
+    assert summary["sessions"][0]["target_id"] == 1
+    # The source's now-stale copy is freed: the slot doesn't leak, and a
+    # later failover back can never silently continue the stale window.
+    assert summary["sessions"][0]["source_released"] is True
+    assert "a" not in apps[0]._sessions
+
+    status, body = _act(router, "a")
+    assert status == 200
+    assert body["migrated"] is True
+    assert "restarted" not in body
+    assert body["replica_id"] == 1
+    # The window survived the move: step 3 next, exactly as if nothing
+    # had happened (the stub's action is a pure function of the step).
+    assert body["step_index"] == 3
+    assert body["action"] == stub_action(3)
+    assert body["session_started"] is False
+    # The flag is consumed: the act after reads as plain ok.
+    status, body = _act(router, "a")
+    assert status == 200 and "migrated" not in body
+
+    assert apps[0].migration_exports == 1
+    assert apps[1].migration_imports == 1
+    assert router.slo.gauges()["slo_requests_migrated"] == 1
+    # Migrated counts as GOOD for availability — the user kept their
+    # window; only true restarts burn budget.
+    assert router.slo.gauges()["slo_availability_rolling"] == 1.0
+
+
+def test_failed_import_falls_back_to_restart_not_5xx(fleet):
+    router, _, _ = fleet
+    status, body = _act(router, "a")
+    assert status == 200 and body["replica_id"] == 0
+    faults.install(faults.FaultPlan.parse("migrate_import@1"))
+    summary = router.migrate_sessions_from(
+        0, reason="drain", orphan_on_failure=True
+    )
+    assert summary["failed"] == 1 and summary["migrated"] == 0
+    entry = summary["sessions"][0]
+    assert entry["orphaned"] is True
+    assert "injected fault" in entry["error"]
+    # The legacy restart path picks the orphan up: 200, never a 5xx.
+    status, body = _act(router, "a")
+    assert status == 200
+    assert body["restarted"] is True
+    assert "migrated" not in body
+    assert router.slo.gauges()["slo_requests_restarted"] == 1
+
+
+def test_cross_generation_target_is_skipped_without_orphaning(fleet):
+    router, apps, _ = fleet
+    status, body = _act(router, "a")
+    assert status == 200 and body["replica_id"] == 0
+    # Survivor reloads to a new checkpoint generation: its surface no
+    # longer matches the source, so migration refuses pre-flight.
+    code, _ = apps[1].reload({"step": 5})
+    assert code == 200
+    summary = router.migrate_sessions_from(0, reason="reload")
+    assert summary["migrated"] == 0 and summary["failed"] == 1
+    assert summary["attempted"] == 0  # no doomed import was even tried
+    assert "no compatible ready survivor" in summary["sessions"][0]["error"]
+    # Without orphan_on_failure the session stays home and keeps serving
+    # (the rolling-reload path: the in-place swap preserves the window).
+    status, body = _act(router, "a")
+    assert status == 200 and body["replica_id"] == 0
+    assert body["step_index"] == 1
+    assert "restarted" not in body and "migrated" not in body
+
+
+def test_rebalance_moves_hottest_sessions(fleet):
+    router, _, _ = fleet
+    status, body = _act(router, "a")  # -> 0
+    assert status == 200 and body["replica_id"] == 0
+    status, body = _act(router, "b")  # -> 1
+    assert status == 200 and body["replica_id"] == 1
+    status, body = _act(router, "c")  # -> 0 or 1; act again to heat "a"
+    assert status == 200
+    status, _ = _act(router, "a")
+    assert router.hottest_sessions(0, 1) == ["a"]
+    status, body = router.rebalance(0, 1)
+    assert status == 200 and body["ok"] is True and body["migrated"] == 1
+    assert body["sessions"][0]["source_released"] is True
+    status, body = _act(router, "a")
+    assert status == 200
+    assert body["migrated"] is True and body["replica_id"] == 1
+    assert body["step_index"] == 2
+    # Unknown replica: a clean 404, not a silent no-op.
+    status, body = router.rebalance(99, 1)
+    assert status == 404
+
+
+def test_router_http_surface_for_rebalance_and_scale_down(fleet):
+    router, _, servers = fleet
+    httpd = make_router_server(router)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    url = f"http://{host}:{port}"
+    try:
+        code, body = _post(url + "/rebalance", {"replica_id": "zero"})
+        assert code == 400 and "replica_id" in body["error"]
+        code, body = _post(url + "/rebalance", {"replica_id": 0, "count": 0})
+        assert code == 400 and "count" in body["error"]
+        code, body = _post(url + "/rebalance", {"replica_id": 99})
+        assert code == 404
+        code, body = _post(url + "/act",
+                           {"session_id": "h", "image_b64": "AAAA"})
+        assert code == 200
+        code, body = _post(url + "/rebalance", {"replica_id": 0, "count": 1})
+        assert code == 200 and body["ok"] is True
+        # Scale-down is a fleet-supervisor verb: 404 on a bare router...
+        code, body = _post(url + "/scale_down", {})
+        assert code == 404 and "no fleet supervisor armed" in body["error"]
+        # ...200 through an armed hook, 400 when the hook refuses.
+        router.scale_down_fn = lambda payload: {
+            "ok": True, "replica_id": 1, "draining": True
+        }
+        code, body = _post(url + "/scale_down", {})
+        assert code == 200 and body["draining"] is True
+
+        def _refuse(payload):
+            raise ValueError("cannot retire the last replica")
+
+        router.scale_down_fn = _refuse
+        code, body = _post(url + "/scale_down", {})
+        assert code == 400 and "last replica" in body["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------- satellite 1: orphan bound
+
+
+def test_orphan_bound_evicts_oldest_first():
+    """Regression for the arbitrary-set.pop eviction: under pressure the
+    OLDEST orphan flag is dropped, and re-orphaning refreshes recency."""
+    router = Router(max_tracked_sessions=3)
+    with router._lock:
+        for sid in ("a", "b", "c"):
+            router._mark_orphaned_locked(sid)
+        router._mark_orphaned_locked("a")  # re-orphan: "a" is newest now
+        router._mark_orphaned_locked("d")  # over bound: evict oldest ("b")
+    assert list(router._orphaned) == ["c", "a", "d"]
+    # The freshest orphan always survives eviction pressure.
+    with router._lock:
+        for i in range(10):
+            router._mark_orphaned_locked(f"churn-{i}")
+    assert list(router._orphaned) == ["churn-7", "churn-8", "churn-9"]
+    # Same ordered-set discipline for the migrated-flag map.
+    with router._lock:
+        for sid in ("m1", "m2", "m3", "m4"):
+            router._mark_migrated_locked(sid)
+    assert list(router._migrated) == ["m2", "m3", "m4"]
+
+
+# ------------------------------------------- satellite 5: naming + alerting
+
+
+def test_migration_metric_families_follow_naming_contract():
+    text = ServeMetrics().prometheus_text(
+        sessions_migrated_total=3,
+        migration_exports_total=1,
+        migration_imports_total=2,
+        migration_import_failures_total=0,
+        migration_restores_total=0,
+        migration_restore_failures_total=0,
+    )
+    for family in (
+        "rt1_serve_sessions_migrated_total",
+        "rt1_serve_migration_exports_total",
+        "rt1_serve_migration_imports_total",
+        "rt1_serve_migration_import_failures_total",
+        "rt1_serve_migration_restores_total",
+        "rt1_serve_migration_restore_failures_total",
+    ):
+        assert f"# TYPE {family} counter" in text, family
+    assert "rt1_serve_migration_imports_total 2" in text
+    # The fleet fan-out mirrors every replica family under the
+    # rt1_serve_replica_ prefix — the names alert rules subscribe to.
+    names = set(prom.fleet_metric_names())
+    for family in (
+        "rt1_serve_replica_migration_exports_total",
+        "rt1_serve_replica_migration_imports_total",
+        "rt1_serve_replica_migration_import_failures_total",
+        "rt1_serve_replica_migration_restores_total",
+        "rt1_serve_replica_migration_restore_failures_total",
+    ):
+        assert family in names, family
+
+
+def test_migration_gauges_absent_until_armed():
+    """An idle stub's /metrics stays byte-stable: migration families
+    appear only once the machinery is armed or a counter moves."""
+    app = StubReplicaApp(replica_id=0)
+    assert "migration_exports_total" not in app.metrics_snapshot()
+    code, _ = app.act({"session_id": "s", "image_b64": "AAAA"})
+    assert code == 200
+    assert "migration_exports_total" not in app.metrics_snapshot()
+    code, body = app.session_export({"session_id": "s"})
+    assert code == 200
+    snap = app.metrics_snapshot()
+    assert snap["migration_exports_total"] == 1
+
+
+def test_migration_failure_storm_rule_in_default_ruleset():
+    rules = {r.name: r for r in default_ruleset()}
+    assert "MigrationFailureStorm" in rules
+    rule = rules["MigrationFailureStorm"]
+    assert rule.severity == "warn"
+    assert "migration" in rule.annotations.get("summary", "").lower()
+
+
+def test_migration_fault_sites_registered():
+    for site in ("migrate_export", "migrate_import", "session_restore"):
+        assert site in faults.KNOWN_SITES, site

@@ -701,6 +701,73 @@ def test_continuous_bucketed_stack_end_to_end(serving_stack):
             app.drain()
 
 
+def test_session_export_import_token_identical(serving_stack):
+    """Durable sessions on the real engine (ISSUE 19): export a live
+    session's window, import it under a new id on the same stack, and
+    the next act returns byte-identical action tokens — the continuation
+    the user would have seen had nothing moved. The /act body carries no
+    step index, so continuity is judged by the tokens themselves plus
+    the export/import responses' step_index."""
+    _, engine, _, url = serving_stack
+    emb = [0.01 * (i % 50) for i in range(D)]
+
+    def frame(k):
+        return np.full((H, W, 3), k / 10.0, np.float32).tolist()
+
+    for k in range(3):
+        status, body = _post(
+            url + "/act",
+            {"session_id": "mig-src", "image": frame(k), "embedding": emb},
+        )
+        assert status == 200
+    status, body = _post(url + "/session/export", {"session_id": "mig-src"})
+    assert status == 200 and body["ok"] is True
+    snapshot = body["snapshot"]
+    assert snapshot["step_index"] == 3
+    assert snapshot["window"] == T
+    assert snapshot["version"] == 1
+    # The reference continuation: step 4 served from the source window.
+    status, ref = _post(
+        url + "/act",
+        {"session_id": "mig-src", "image": frame(3), "embedding": emb},
+    )
+    assert status == 200 and ref["session_started"] is False
+
+    status, body = _post(
+        url + "/session/import",
+        {"snapshot": snapshot, "session_id": "mig-dst"},
+    )
+    assert status == 200 and body["ok"] is True
+    assert body["step_index"] == 3
+    status, cont = _post(
+        url + "/act",
+        {"session_id": "mig-dst", "image": frame(3), "embedding": emb},
+    )
+    assert status == 200
+    assert cont["session_started"] is False  # the window moved, whole
+    assert cont["action_tokens"] == ref["action_tokens"]
+    assert cont["action"] == ref["action"]
+
+    # Compatibility refusals are 409s that NAME the mismatched field.
+    status, body = _post(
+        url + "/session/import",
+        {
+            "snapshot": {**snapshot, "checkpoint_generation": 12345},
+            "session_id": "mig-bad",
+        },
+    )
+    assert status == 409 and "checkpoint_generation" in body["error"]
+    # Exporting a session that was never opened is a 404, not a crash.
+    status, body = _post(url + "/session/export", {"session_id": "ghost"})
+    assert status == 404
+
+    # Import scatters into the live batched step: no recompile.
+    status, health = _get(url + "/healthz")
+    assert health["compile_count"] == 1
+    for sid in ("mig-src", "mig-dst"):
+        _post(url + "/release", {"session_id": sid})
+
+
 def test_drain_rejects_new_work(serving_stack):
     """Runs last (name-independent: fixtures are module-scoped, and this
     mutates app state — keep it after the traffic tests)."""
